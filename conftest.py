@@ -1,0 +1,25 @@
+"""Pytest root conftest: run the suite on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; JAX's host-platform device
+emulation gives the suite 8 virtual CPU devices so mesh/psum sharding code
+runs for real. Must be set before the first ``import jax``.
+"""
+
+import os
+import sys
+
+# Hard override: the container profile exports JAX_PLATFORMS=axon (the real
+# TPU tunnel); the suite must run on the virtual CPU mesh regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# Keep TF (used only by h5-importer parity tests) off any accelerator and quiet.
+os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+# Persistent XLA compilation cache: the U-Net programs take O(10s) each to
+# compile on CPU; cache them across test runs.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))

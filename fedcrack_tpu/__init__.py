@@ -1,0 +1,18 @@
+"""tpu-fedcrack: a TPU-native federated learning framework for crack segmentation.
+
+Built from scratch in JAX/Flax/XLA with the capabilities of the reference
+``MunHyeon-Kim/Crack-Detection-FederatedLearning-gRPC`` (see SURVEY.md):
+
+- ``models``    — residual U-Net (Flax) mirroring the reference architecture
+                  (reference: client_fit_model.py:92-150).
+- ``ops``       — losses/metrics (sigmoid-BCE, pixel accuracy, IoU).
+- ``data``      — crack-image input pipeline with host-side prefetch; synthetic
+                  fixtures; IID/non-IID client sharding
+                  (reference: client_fit_model.py:19-90).
+
+See SURVEY.md §7 for the full build plan this package follows.
+"""
+
+__version__ = "0.1.0"
+
+from fedcrack_tpu.configs import FedConfig, ModelConfig, DataConfig  # noqa: F401

@@ -1,0 +1,135 @@
+"""Single dataclass-based configuration system.
+
+The reference has no config system: constants are module globals
+(``fl_server.py:17-18``), magic ctor args (``fl_client.py:102``,
+``fl_server.py:230-231``), hardcoded dataset paths
+(``client_fit_model.py:58-59``) and a hardcoded port (``fl_server.py:218``).
+Here every knob lives in one serializable config that also travels in-band in
+the protocol handshake config map (SURVEY.md §2.4), closing SURVEY.md §5.6.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Mapping
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Residual U-Net hyperparameters (reference: client_fit_model.py:92-150).
+
+    The reference hardcodes 128x128x3 inputs, encoder filters [64, 128, 256],
+    decoder filters [256, 128, 64, 32] and a single-sigmoid head.
+    """
+
+    img_size: int = 128
+    in_channels: int = 3
+    num_classes: int = 1
+    stem_features: int = 32
+    encoder_features: tuple[int, ...] = (64, 128, 256)
+    decoder_features: tuple[int, ...] = (256, 128, 64, 32)
+    # "bfloat16" compute with float32 params is the TPU-native default; the
+    # reference trains in float32 throughout.
+    compute_dtype: str = "float32"
+    param_dtype: str = "float32"
+
+    def __post_init__(self) -> None:
+        # stem /2 + three pools /2 then four x2 upsamples: output comes back to
+        # img_size only when img_size is a multiple of 16; otherwise the head
+        # would silently emit a larger map than the mask.
+        if self.img_size % 16 != 0 or self.img_size <= 0:
+            raise ValueError(
+                f"img_size must be a positive multiple of 16, got {self.img_size}"
+            )
+
+    @property
+    def input_shape(self) -> tuple[int, int, int]:
+        return (self.img_size, self.img_size, self.in_channels)
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Dataset layout + split semantics (reference: client_fit_model.py:54-90)."""
+
+    image_dir: str = ""
+    mask_dir: str = ""
+    img_size: int = 128
+    batch_size: int = 16          # reference: client_fit_model.py:55
+    split_seed: int = 1337        # reference: client_fit_model.py:77-78
+    train_samples: int = 6213     # reference: client_fit_model.py:76
+    # "iid" or "skew" (per-client crack-density skew, SURVEY.md §7 step 2)
+    partition: str = "iid"
+    skew_alpha: float = 0.3       # Dirichlet concentration for non-IID shards
+    prefetch: int = 2
+    num_workers: int = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class FedConfig:
+    """Federation round/protocol configuration.
+
+    Reference values: MAX_NUM_ROUND=5 (fl_server.py:18), 10 s registration
+    window (fl_server.py:42), 20 s version poll (fl_client.py:141), local
+    epochs hardcoded to 10 (client_fit_model.py:166).
+    """
+
+    max_rounds: int = 5
+    cohort_size: int = 2
+    local_epochs: int = 10
+    learning_rate: float = 1e-3
+    registration_window_s: float = 10.0
+    poll_period_s: float = 20.0
+    # Per-round deadline; on expiry the cohort shrinks to the clients that
+    # reported (fixes the reference's forever-hanging barrier, SURVEY.md §5.3).
+    round_deadline_s: float = 0.0  # 0 = no deadline
+    # FedProx proximal term; 0 disables (plain FedAvg).
+    fedprox_mu: float = 0.0
+    # Advertised model type. The reference advertises the vestigial string
+    # "mobilenet_v2" (fl_server.py:75) while actually sharing the U-Net; we
+    # advertise honestly but accept the legacy alias (SURVEY.md §2.2(3)).
+    model_type: str = "resunet"
+    host: str = "127.0.0.1"
+    port: int = 8889              # reference: fl_server.py:218
+    max_message_mb: int = 512     # reference: fl_server.py:215 (both directions here)
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    # Mesh shape for the TPU data plane: (#federated clients, per-client DP).
+    mesh_clients: int = 8
+    mesh_batch: int = 1
+
+    def __post_init__(self) -> None:
+        if self.data.img_size != self.model.img_size:
+            raise ValueError(
+                "data.img_size and model.img_size must match; got "
+                f"{self.data.img_size} vs {self.model.img_size}"
+            )
+
+    # ---- serialization (in-band config map + files) ----
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, blob: str | bytes) -> "FedConfig":
+        raw = json.loads(blob)
+        return cls.from_dict(raw)
+
+    @classmethod
+    def from_dict(cls, raw: Mapping[str, Any]) -> "FedConfig":
+        raw = dict(raw)
+        model = raw.pop("model", {})
+        data = raw.pop("data", {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        raw = {k: v for k, v in raw.items() if k in known}
+        mknown = {f.name for f in dataclasses.fields(ModelConfig)}
+        dknown = {f.name for f in dataclasses.fields(DataConfig)}
+        mc = ModelConfig(**{k: _detuple(k, v) for k, v in model.items() if k in mknown})
+        dc = DataConfig(**{k: v for k, v in data.items() if k in dknown})
+        return cls(model=mc, data=dc, **raw)
+
+
+def _detuple(key: str, value: Any) -> Any:
+    if key in ("encoder_features", "decoder_features") and isinstance(value, list):
+        return tuple(value)
+    return value

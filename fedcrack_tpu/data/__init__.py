@@ -1,0 +1,8 @@
+from fedcrack_tpu.data.pipeline import (  # noqa: F401
+    CrackDataset,
+    list_pairs,
+    load_example,
+    reference_split,
+)
+from fedcrack_tpu.data.sharding import partition_iid, partition_skew  # noqa: F401
+from fedcrack_tpu.data.synthetic import synth_crack_batch, write_synthetic_dataset  # noqa: F401

@@ -1,0 +1,234 @@
+"""Host-side input pipeline.
+
+Capability parity with the reference's ``Generator`` + split logic
+(reference: client_fit_model.py:19-43,54-90) with the accidents fixed and the
+throughput problems solved:
+
+- **Pairing by stem**, not by parallel independent shuffles. The reference
+  shuffles image and mask path lists *independently* with the same seed and
+  relies on identical filename sort order for pairing (client_fit_model.py:77-78,
+  SURVEY.md §2.2(9)); here pairs are formed explicitly and shuffled together.
+- **Same tensor contract**: BGR→RGB, resize to ``img_size``, /255 float32
+  images; masks resized then binarized ``>0`` to {0,1} float32 with a channel
+  dim (client_fit_model.py:30-43).
+- **Prefetch**: the reference decodes 16 images synchronously before every
+  train step (SURVEY.md §3.3 "the input pipeline is a first-order bottleneck");
+  here a thread pool decodes ahead of the device and batches are handed off
+  through a bounded queue.
+
+Static shapes: batches are always exactly ``batch_size`` (last partial batch
+dropped) so every train step hits the same compiled program.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import queue
+import random
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, Sequence
+
+import numpy as np
+
+
+def list_pairs(image_dir: str, mask_dir: str) -> list[tuple[str, str]]:
+    """Paired (image_path, mask_path) lists, matched by filename stem."""
+
+    def stems(d: str) -> dict[str, str]:
+        out = {}
+        for fname in sorted(os.listdir(d)):
+            if fname.startswith(".") or not fname.lower().endswith(
+                (".jpg", ".jpeg", ".png", ".bmp")
+            ):
+                continue
+            out[os.path.splitext(fname)[0]] = os.path.join(d, fname)
+        return out
+
+    imgs, masks = stems(image_dir), stems(mask_dir)
+    common = sorted(imgs.keys() & masks.keys())
+    if not common:
+        raise FileNotFoundError(
+            f"no paired images/masks between {image_dir!r} and {mask_dir!r}"
+        )
+    return [(imgs[s], masks[s]) for s in common]
+
+
+def reference_split(
+    pairs: Sequence[tuple[str, str]],
+    train_samples: int = 6213,
+    seed: int = 1337,
+) -> tuple[list[tuple[str, str]], list[tuple[str, str]]]:
+    """Deterministic train/val split with the reference's semantics.
+
+    The reference shuffles with ``random.Random(1337)`` and takes the first
+    ``train_samples`` paths as train, the rest as val (client_fit_model.py:76-82).
+    Pairs are shuffled jointly here (see module docstring).
+    """
+    shuffled = list(pairs)
+    random.Random(seed).shuffle(shuffled)
+    train_samples = min(train_samples, max(1, len(shuffled) - 1))
+    return shuffled[:train_samples], shuffled[train_samples:]
+
+
+def load_example(
+    image_path: str, mask_path: str, img_size: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Decode one pair to the reference's tensor contract."""
+    import cv2
+
+    img = cv2.imread(image_path, cv2.IMREAD_COLOR)
+    if img is None:
+        raise FileNotFoundError(image_path)
+    img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    img = cv2.resize(img, (img_size, img_size))
+    image = img.astype(np.float32) / 255.0
+
+    m = cv2.imread(mask_path, cv2.IMREAD_GRAYSCALE)
+    if m is None:
+        raise FileNotFoundError(mask_path)
+    m = cv2.resize(m, (img_size, img_size))
+    mask = (m > 0).astype(np.float32)[..., None]
+    return image, mask
+
+
+class CrackDataset:
+    """Batched, shuffled, prefetching iterator over paired crack images.
+
+    Yields numpy ``(images [B,S,S,3] float32, masks [B,S,S,1] float32)``.
+    """
+
+    def __init__(
+        self,
+        pairs: Sequence[tuple[str, str]],
+        img_size: int = 128,
+        batch_size: int = 16,
+        shuffle: bool = True,
+        seed: int = 0,
+        num_workers: int = 4,
+        prefetch: int = 2,
+        drop_last: bool = True,
+    ):
+        if not pairs:
+            raise ValueError("empty dataset")
+        self.pairs = list(pairs)
+        self.img_size = img_size
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.num_workers = num_workers
+        self.prefetch = prefetch
+        self.drop_last = drop_last
+        self._epoch = 0
+
+    def __len__(self) -> int:
+        n = len(self.pairs) // self.batch_size
+        if not self.drop_last and len(self.pairs) % self.batch_size:
+            n += 1
+        return n
+
+    def _batch_indices(self) -> list[np.ndarray]:
+        order = np.arange(len(self.pairs))
+        if self.shuffle:
+            np.random.default_rng(self.seed + self._epoch).shuffle(order)
+        nb = len(self)
+        return [
+            order[i * self.batch_size : (i + 1) * self.batch_size] for i in range(nb)
+        ]
+
+    def _load_batch(self, idx: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        images = np.empty((len(idx), self.img_size, self.img_size, 3), np.float32)
+        masks = np.empty((len(idx), self.img_size, self.img_size, 1), np.float32)
+        for j, i in enumerate(idx):
+            images[j], masks[j] = load_example(*self.pairs[i], self.img_size)
+        return images, masks
+
+    def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        batches = self._batch_indices()
+        self._epoch += 1
+        if self.num_workers <= 0:
+            for idx in batches:
+                yield self._load_batch(idx)
+            return
+
+        # Bounded producer/consumer: workers decode ahead of the device, but
+        # only `num_workers + prefetch` batches are ever in flight — the
+        # submission is lazy, so a slow consumer bounds memory, and every
+        # q.put observes `stop` so an early consumer exit can't strand the
+        # producer thread.
+        q: queue.Queue = queue.Queue(maxsize=max(1, self.prefetch))
+        stop = threading.Event()
+
+        def put_or_abort(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            max_outstanding = self.num_workers + max(1, self.prefetch)
+            batch_iter = iter(batches)
+            pending: collections.deque = collections.deque()
+            with ThreadPoolExecutor(self.num_workers) as pool:
+                while not stop.is_set():
+                    while len(pending) < max_outstanding:
+                        idx = next(batch_iter, None)
+                        if idx is None:
+                            break
+                        pending.append(pool.submit(self._load_batch, idx))
+                    if not pending:
+                        break
+                    fut = pending.popleft()
+                    try:
+                        item = ("ok", fut.result())
+                    except Exception as e:  # surface decode errors to consumer
+                        item = ("err", e)
+                    if not put_or_abort(item) or item[0] == "err":
+                        break
+                for fut in pending:
+                    fut.cancel()
+            put_or_abort(("end", None))
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                kind, payload = q.get()
+                if kind == "end":
+                    return
+                if kind == "err":
+                    raise payload
+                yield payload
+        finally:
+            stop.set()
+            # unblock a producer mid-put; it exits via the stop check
+            while not q.empty():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=5.0)
+
+
+def device_prefetch(iterator, size: int = 2):
+    """Overlap host decode with device compute: device_put batches ahead."""
+    import jax
+
+    buf = collections.deque()
+    it = iter(iterator)
+    try:
+        for _ in range(size):
+            buf.append(jax.device_put(next(it)))
+    except StopIteration:
+        pass
+    while buf:
+        nxt = buf.popleft()
+        try:
+            buf.append(jax.device_put(next(it)))
+        except StopIteration:
+            pass
+        yield nxt

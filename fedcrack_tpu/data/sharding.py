@@ -1,0 +1,67 @@
+"""Client shard assignment for federated training.
+
+The reference has no sharding at all — every client reads the same local
+dataset directory (client_fit_model.py:58-59). Here the coordinator (or an
+offline tool) assigns disjoint shards: IID uniform, or non-IID with
+per-client crack-density skew (BASELINE.md config 4: "non-IID client shards
+(per-client crack-type skew) + FedProx mu>0").
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+def partition_iid(
+    n_samples: int, num_clients: int, seed: int = 0
+) -> list[np.ndarray]:
+    """Uniform random disjoint shards, near-equal sizes."""
+    if num_clients <= 0:
+        raise ValueError("num_clients must be positive")
+    order = np.arange(n_samples)
+    np.random.default_rng(seed).shuffle(order)
+    return [np.sort(s) for s in np.array_split(order, num_clients)]
+
+
+def partition_skew(
+    scores: Sequence[float],
+    num_clients: int,
+    alpha: float = 0.3,
+    seed: int = 0,
+) -> list[np.ndarray]:
+    """Non-IID shards skewed by a per-sample score (e.g. crack density).
+
+    Samples are bucketed into ``num_clients`` score quantiles; a Dirichlet(α)
+    mixing matrix assigns each bucket across clients, so small α → each client
+    sees mostly one crack-density regime (heavy cracks vs hairline vs clean).
+    Every sample lands on exactly one client; shards are disjoint and cover.
+    """
+    if num_clients <= 0:
+        raise ValueError("num_clients must be positive")
+    scores = np.asarray(scores, np.float64)
+    n = scores.shape[0]
+    rng = np.random.default_rng(seed)
+    by_score = np.argsort(scores, kind="stable")
+    buckets = np.array_split(by_score, num_clients)
+
+    shards: list[list[int]] = [[] for _ in range(num_clients)]
+    for b, bucket in enumerate(buckets):
+        # proportions of this quantile bucket going to each client; biased
+        # toward client b so α→0 degenerates to "client b owns quantile b"
+        props = rng.dirichlet(np.full(num_clients, alpha) + (np.arange(num_clients) == b))
+        counts = np.floor(props * len(bucket)).astype(int)
+        counts[b] += len(bucket) - counts.sum()  # remainder to the home client
+        perm = rng.permutation(bucket)
+        start = 0
+        for c in range(num_clients):
+            shards[c].extend(perm[start : start + counts[c]].tolist())
+            start += counts[c]
+    return [np.sort(np.asarray(s, dtype=np.int64)) for s in shards]
+
+
+def crack_density(masks: np.ndarray) -> np.ndarray:
+    """Per-sample fraction of crack pixels — the default skew score."""
+    masks = np.asarray(masks)
+    return masks.reshape(masks.shape[0], -1).mean(axis=1)

@@ -1,0 +1,31 @@
+"""Model registry.
+
+The reference advertises the (vestigial) model type string "mobilenet_v2"
+(reference: fl_server.py:75) while server and client actually share one
+architecture — the residual U-Net (reference: client_fit_model.py:92-150,
+SURVEY.md §2.2(3)). The registry accepts the legacy alias so a reference
+client's handshake still resolves to the real model.
+"""
+
+from __future__ import annotations
+
+from fedcrack_tpu.configs import ModelConfig
+from fedcrack_tpu.models.resunet import ResUNet
+
+_ALIASES = {
+    "resunet": "resunet",
+    "unet": "resunet",
+    # Legacy alias: the reference's advertised-but-vestigial model type string.
+    "mobilenet_v2": "resunet",
+}
+
+
+def get_model(name: str = "resunet", config: ModelConfig | None = None) -> ResUNet:
+    """Build a model by registry name (case-insensitive, legacy aliases ok)."""
+    key = _ALIASES.get(name.lower())
+    if key is None:
+        raise KeyError(f"unknown model type {name!r}; known: {sorted(_ALIASES)}")
+    return ResUNet(config=config or ModelConfig())
+
+
+__all__ = ["ResUNet", "get_model"]

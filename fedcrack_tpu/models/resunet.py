@@ -1,0 +1,175 @@
+"""Residual U-Net for crack segmentation, as a Flax module.
+
+Capability parity with the reference's Keras builder
+(reference: client_fit_model.py:92-150, identical in test/Segmentation.py:102-159):
+
+- stem ``Conv(32, 3x3, stride 2, SAME)`` + BN + ReLU
+- encoder blocks, filters (64, 128, 256): two ``ReLU -> SeparableConv -> BN``
+  then ``MaxPool(3x3, stride 2, SAME)``, with a strided 1x1-conv residual add
+- decoder blocks, filters (256, 128, 64, 32): two ``ReLU -> ConvT(3x3) -> BN``
+  then nearest x2 upsampling, with an upsampled 1x1-conv residual add
+- head ``Conv(1, 1x1)`` — this module returns **logits**; the reference bakes
+  sigmoid into the head (client_fit_model.py:145) and we apply it in the loss
+  (numerically stable) and in ``predict``.
+
+TPU-first choices: NHWC layout, optional bfloat16 compute with float32 params,
+static shapes throughout (everything jit/pjit-traceable), BatchNorm hyperparams
+matched to Keras defaults (momentum 0.99, eps 1e-3) so an h5 weight import is
+tensor-for-tensor (SURVEY.md §7 "hard parts").
+
+Spatial bookkeeping: stem /2 and three pools /2 take 128x128 -> 8x8 at the
+bottleneck; four x2 upsampling stages return to 128x128, matching the
+full-resolution masks (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fedcrack_tpu.configs import ModelConfig
+
+# Keras BatchNormalization defaults (the reference relies on them).
+_BN_MOMENTUM = 0.99
+_BN_EPSILON = 1e-3
+
+_glorot = nn.initializers.glorot_uniform()
+
+
+def upsample2x(x: jax.Array) -> jax.Array:
+    """Nearest-neighbor x2 upsampling on NHWC, Keras ``UpSampling2D(2)`` semantics."""
+    x = jnp.repeat(x, 2, axis=1)
+    x = jnp.repeat(x, 2, axis=2)
+    return x
+
+
+class SeparableConv(nn.Module):
+    """Depthwise 3x3 + pointwise 1x1, Keras ``SeparableConv2D`` semantics.
+
+    Keras puts the bias only on the pointwise projection; the depthwise stage
+    is bias-free with depth_multiplier=1.
+    """
+
+    features: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        in_features = x.shape[-1]
+        x = nn.Conv(
+            features=in_features,
+            kernel_size=(3, 3),
+            feature_group_count=in_features,
+            padding="SAME",
+            use_bias=False,
+            kernel_init=_glorot,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="depthwise",
+        )(x)
+        x = nn.Conv(
+            features=self.features,
+            kernel_size=(1, 1),
+            padding="SAME",
+            use_bias=True,
+            kernel_init=_glorot,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="pointwise",
+        )(x)
+        return x
+
+
+class ResUNet(nn.Module):
+    """The crack-segmentation residual U-Net. Returns per-pixel logits."""
+
+    config: ModelConfig = ModelConfig()
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        cfg = self.config
+        dtype = jnp.dtype(cfg.compute_dtype)
+        pdtype = jnp.dtype(cfg.param_dtype)
+        conv_kw = dict(
+            padding="SAME", kernel_init=_glorot, dtype=dtype, param_dtype=pdtype
+        )
+
+        def bn(name: str):
+            return nn.BatchNorm(
+                use_running_average=not train,
+                momentum=_BN_MOMENTUM,
+                epsilon=_BN_EPSILON,
+                dtype=dtype,
+                param_dtype=pdtype,
+                name=name,
+            )
+
+        x = x.astype(dtype)
+
+        # Entry block (stem): /2.
+        x = nn.Conv(cfg.stem_features, (3, 3), strides=(2, 2), name="stem_conv", **conv_kw)(x)
+        x = bn("stem_bn")(x)
+        x = nn.relu(x)
+        previous = x  # residual carried across blocks
+
+        # Encoder: each block halves H,W.
+        for i, features in enumerate(cfg.encoder_features):
+            x = nn.relu(x)
+            x = SeparableConv(features, dtype=dtype, param_dtype=pdtype, name=f"enc{i}_sep1")(x)
+            x = bn(f"enc{i}_bn1")(x)
+            x = nn.relu(x)
+            x = SeparableConv(features, dtype=dtype, param_dtype=pdtype, name=f"enc{i}_sep2")(x)
+            x = bn(f"enc{i}_bn2")(x)
+            x = nn.max_pool(x, window_shape=(3, 3), strides=(2, 2), padding="SAME")
+            residual = nn.Conv(
+                features, (1, 1), strides=(2, 2), name=f"enc{i}_res", **conv_kw
+            )(previous)
+            x = x + residual
+            previous = x
+
+        # Decoder: each block doubles H,W.
+        for i, features in enumerate(cfg.decoder_features):
+            x = nn.relu(x)
+            x = nn.ConvTranspose(
+                features, (3, 3), padding="SAME", kernel_init=_glorot,
+                dtype=dtype, param_dtype=pdtype, name=f"dec{i}_convT1",
+            )(x)
+            x = bn(f"dec{i}_bn1")(x)
+            x = nn.relu(x)
+            x = nn.ConvTranspose(
+                features, (3, 3), padding="SAME", kernel_init=_glorot,
+                dtype=dtype, param_dtype=pdtype, name=f"dec{i}_convT2",
+            )(x)
+            x = bn(f"dec{i}_bn2")(x)
+            x = upsample2x(x)
+            residual = nn.Conv(features, (1, 1), name=f"dec{i}_res", **conv_kw)(
+                upsample2x(previous)
+            )
+            x = x + residual
+            previous = x
+
+        # Per-pixel classification head; logits in float32 for a stable loss.
+        logits = nn.Conv(
+            cfg.num_classes, (1, 1), padding="SAME", kernel_init=_glorot,
+            dtype=jnp.float32, param_dtype=pdtype, name="head",
+        )(x.astype(jnp.float32))
+        return logits
+
+
+def init_variables(rng: jax.Array, config: ModelConfig | None = None) -> dict:
+    """Initialize {'params', 'batch_stats'} for the model (host-side helper)."""
+    config = config or ModelConfig()
+    model = ResUNet(config=config)
+    dummy = jnp.zeros((1, *config.input_shape), jnp.float32)
+    return model.init(rng, dummy, train=False)
+
+
+def predict(variables: dict, images: jax.Array, config: ModelConfig | None = None) -> jax.Array:
+    """Sigmoid probabilities for a batch of images (inference mode)."""
+    model = ResUNet(config=config or ModelConfig())
+    logits = model.apply(variables, images, train=False)
+    return jax.nn.sigmoid(logits)

@@ -1,0 +1,6 @@
+from fedcrack_tpu.ops.losses import (  # noqa: F401
+    sigmoid_bce,
+    pixel_accuracy,
+    binary_iou,
+    segmentation_metrics,
+)

@@ -1,0 +1,80 @@
+"""Losses and quality metrics for binary crack segmentation.
+
+The reference trains with Keras ``binary_crossentropy`` on sigmoid outputs and
+tracks pixel ``accuracy`` only (reference: client_fit_model.py:157,
+test/Segmentation.py:183). Here the loss is computed from **logits**
+(numerically stable log-sigmoid form) and crack IoU is added as the
+north-star quality metric the reference lacked (BASELINE.md).
+
+All functions are pure jnp — safe under jit/vmap/shard_map — and reduce in
+float32 regardless of the compute dtype (bf16-safe accumulation).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def sigmoid_bce(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean binary cross-entropy over all pixels, from logits.
+
+    Matches Keras ``binary_crossentropy`` applied to ``sigmoid(logits)`` up to
+    clipping; computed as ``max(l,0) - l*y + log1p(exp(-|l|))`` for stability.
+    """
+    logits = logits.astype(jnp.float32)
+    labels = labels.astype(jnp.float32)
+    per_pixel = optax.sigmoid_binary_cross_entropy(logits, labels)
+    return jnp.mean(per_pixel)
+
+
+def pixel_accuracy(logits: jax.Array, labels: jax.Array, threshold: float = 0.5) -> jax.Array:
+    """Fraction of pixels whose thresholded prediction matches the mask."""
+    preds = (jax.nn.sigmoid(logits.astype(jnp.float32)) > threshold)
+    labels = labels > 0.5
+    return jnp.mean((preds == labels).astype(jnp.float32))
+
+
+def binary_iou(
+    logits: jax.Array,
+    labels: jax.Array,
+    threshold: float = 0.5,
+) -> jax.Array:
+    """Crack (foreground) intersection-over-union over the whole batch.
+
+    Computed from global pixel counts (not per-image means) so it composes
+    additively across shards: ``psum`` the intersection/union counts and the
+    global IoU is exact. An empty union (no crack predicted, none present)
+    is a perfect prediction and scores 1.0, not 0.
+    """
+    inter, union = iou_counts(logits, labels, threshold)
+    return iou_from_counts(inter, union)
+
+
+def iou_from_counts(inter: jax.Array, union: jax.Array) -> jax.Array:
+    """IoU with the 0/0 -> 1.0 (perfect empty prediction) convention."""
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1.0), 1.0)
+
+
+def iou_counts(
+    logits: jax.Array, labels: jax.Array, threshold: float = 0.5
+) -> tuple[jax.Array, jax.Array]:
+    """(intersection, union) pixel counts — the psum-able form of IoU."""
+    preds = jax.nn.sigmoid(logits.astype(jnp.float32)) > threshold
+    labels = labels > 0.5
+    inter = jnp.sum(jnp.logical_and(preds, labels).astype(jnp.float32))
+    union = jnp.sum(jnp.logical_or(preds, labels).astype(jnp.float32))
+    return inter, union
+
+
+def segmentation_metrics(logits: jax.Array, labels: jax.Array) -> dict[str, jax.Array]:
+    """The per-batch metric dict logged every round (SURVEY.md §5.5 fix)."""
+    inter, union = iou_counts(logits, labels)
+    return {
+        "loss": sigmoid_bce(logits, labels),
+        "pixel_acc": pixel_accuracy(logits, labels),
+        "iou": iou_from_counts(inter, union),
+        "iou_inter": inter,
+        "iou_union": union,
+    }

@@ -1,0 +1,134 @@
+"""Data pipeline: tensor contract, split determinism, pairing, sharding."""
+
+import numpy as np
+import pytest
+
+from fedcrack_tpu.data import (
+    CrackDataset,
+    list_pairs,
+    load_example,
+    partition_iid,
+    partition_skew,
+    reference_split,
+    synth_crack_batch,
+    write_synthetic_dataset,
+)
+from fedcrack_tpu.data.sharding import crack_density
+
+
+@pytest.fixture(scope="module")
+def fixture_dirs(tmp_path_factory):
+    root = tmp_path_factory.mktemp("crackds")
+    return write_synthetic_dataset(str(root), n=24, img_size=64, seed=7)
+
+
+def test_synth_contract():
+    images, masks = synth_crack_batch(4, img_size=64, seed=0)
+    assert images.shape == (4, 64, 64, 3) and images.dtype == np.float32
+    assert masks.shape == (4, 64, 64, 1) and masks.dtype == np.float32
+    assert images.min() >= 0.0 and images.max() <= 1.0
+    assert set(np.unique(masks)) <= {0.0, 1.0}
+
+
+def test_synth_deterministic():
+    a = synth_crack_batch(2, 32, seed=3)
+    b = synth_crack_batch(2, 32, seed=3)
+    assert np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
+
+
+def test_list_pairs_matches_by_stem(fixture_dirs):
+    image_dir, mask_dir = fixture_dirs
+    pairs = list_pairs(image_dir, mask_dir)
+    assert len(pairs) == 24
+    for img_path, mask_path in pairs:
+        import os
+
+        assert os.path.splitext(os.path.basename(img_path))[0] == os.path.splitext(
+            os.path.basename(mask_path)
+        )[0]
+
+
+def test_disk_masks_lossless_roundtrip(fixture_dirs):
+    """On-disk fixture masks must binarize back to the generated masks exactly
+    (JPEG artifacts would leak spurious crack pixels through '>0')."""
+    image_dir, mask_dir = fixture_dirs
+    _, masks = synth_crack_batch(24, img_size=64, seed=7)
+    pairs = list_pairs(image_dir, mask_dir)
+    for i, (_, mask_path) in enumerate(pairs):
+        _, loaded = load_example(pairs[i][0], mask_path, img_size=64)
+        assert np.array_equal(loaded[:, :, 0], masks[i, :, :, 0]), f"mask {i} corrupted"
+
+
+def test_early_consumer_exit_does_not_strand_producer(fixture_dirs):
+    import threading
+
+    pairs = list_pairs(*fixture_dirs)
+    before = threading.active_count()
+    for _ in range(3):
+        ds = CrackDataset(pairs, img_size=64, batch_size=2, prefetch=1, num_workers=2)
+        it = iter(ds)
+        next(it)
+        it.close()  # early exit mid-epoch
+    assert threading.active_count() <= before + 1, "producer threads leaked"
+
+
+def test_load_example_binarizes_and_scales(fixture_dirs):
+    image_dir, mask_dir = fixture_dirs
+    pairs = list_pairs(image_dir, mask_dir)
+    image, mask = load_example(*pairs[0], img_size=64)
+    assert image.shape == (64, 64, 3) and 0.0 <= image.min() and image.max() <= 1.0
+    assert mask.shape == (64, 64, 1)
+    assert set(np.unique(mask)) <= {0.0, 1.0}
+
+
+def test_reference_split_deterministic_and_disjoint(fixture_dirs):
+    pairs = list_pairs(*fixture_dirs)
+    tr1, va1 = reference_split(pairs, train_samples=16, seed=1337)
+    tr2, va2 = reference_split(pairs, train_samples=16, seed=1337)
+    assert tr1 == tr2 and va1 == va2
+    assert len(tr1) == 16 and len(va1) == 8
+    assert not (set(tr1) & set(va1))
+
+
+def test_dataset_static_batches_and_prefetch(fixture_dirs):
+    pairs = list_pairs(*fixture_dirs)
+    ds = CrackDataset(pairs, img_size=64, batch_size=5, seed=0, num_workers=2)
+    batches = list(ds)
+    assert len(batches) == 4  # 24 // 5, last partial dropped (static shapes)
+    for images, masks in batches:
+        assert images.shape == (5, 64, 64, 3)
+        assert masks.shape == (5, 64, 64, 1)
+
+
+def test_dataset_reshuffles_between_epochs(fixture_dirs):
+    pairs = list_pairs(*fixture_dirs)
+    ds = CrackDataset(pairs, img_size=64, batch_size=24, seed=0, num_workers=0)
+    (e1, _), (e2, _) = next(iter(ds)), next(iter(ds))
+    assert not np.array_equal(e1, e2)
+
+
+def test_partition_iid_disjoint_cover():
+    shards = partition_iid(103, 8, seed=1)
+    all_idx = np.concatenate(shards)
+    assert len(all_idx) == 103
+    assert len(np.unique(all_idx)) == 103
+    sizes = [len(s) for s in shards]
+    assert max(sizes) - min(sizes) <= 1
+
+
+def test_partition_skew_disjoint_cover_and_skewed():
+    rng = np.random.default_rng(0)
+    scores = rng.uniform(size=200)
+    shards = partition_skew(scores, 4, alpha=0.05, seed=0)
+    all_idx = np.concatenate(shards)
+    assert len(all_idx) == 200 and len(np.unique(all_idx)) == 200
+    # with tiny alpha each client's mean score should be well separated
+    means = sorted(float(np.mean(scores[s])) for s in shards)
+    assert means[-1] - means[0] > 0.3
+
+
+def test_crack_density():
+    _, masks = synth_crack_batch(6, 32, seed=0, crack_prob=1.0)
+    d = crack_density(masks)
+    assert d.shape == (6,)
+    assert (d > 0).all()

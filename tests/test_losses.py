@@ -1,0 +1,71 @@
+"""Loss/metric math checks against closed forms."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedcrack_tpu.ops import binary_iou, pixel_accuracy, segmentation_metrics, sigmoid_bce
+from fedcrack_tpu.ops.losses import iou_counts
+
+
+def test_bce_matches_manual_form():
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(2, 8, 8, 1)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 2, size=(2, 8, 8, 1)), jnp.float32)
+    p = jax.nn.sigmoid(logits)
+    manual = -jnp.mean(labels * jnp.log(p) + (1 - labels) * jnp.log1p(-p))
+    assert np.allclose(float(sigmoid_bce(logits, labels)), float(manual), atol=1e-5)
+
+
+def test_bce_stable_at_extreme_logits():
+    logits = jnp.asarray([[-80.0, 80.0]])
+    labels = jnp.asarray([[0.0, 1.0]])
+    val = float(sigmoid_bce(logits, labels))
+    assert np.isfinite(val) and val < 1e-6
+
+
+def test_pixel_accuracy_closed_form():
+    logits = jnp.asarray([[10.0, -10.0, 10.0, -10.0]])
+    labels = jnp.asarray([[1.0, 0.0, 0.0, 0.0]])
+    assert float(pixel_accuracy(logits, labels)) == 0.75
+
+
+def test_iou_closed_form():
+    # preds: [1,1,0,0], labels: [1,0,1,0] -> inter=1, union=3
+    logits = jnp.asarray([[10.0, 10.0, -10.0, -10.0]])
+    labels = jnp.asarray([[1.0, 0.0, 1.0, 0.0]])
+    assert abs(float(binary_iou(logits, labels)) - 1 / 3) < 1e-5
+
+
+def test_iou_perfect_empty_prediction_scores_one():
+    """No crack predicted, none present: 0/0 IoU is a perfect score, not 0."""
+    logits = jnp.full((1, 8, 8, 1), -10.0)
+    labels = jnp.zeros((1, 8, 8, 1))
+    assert float(binary_iou(logits, labels)) == 1.0
+    m = segmentation_metrics(logits, labels)
+    assert float(m["iou"]) == 1.0
+
+
+def test_iou_counts_compose_additively_across_shards():
+    """Global IoU from summed counts == IoU of the concatenated batch."""
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(4, 16, 16, 1)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 2, size=(4, 16, 16, 1)), jnp.float32)
+    i_all, u_all = iou_counts(logits, labels)
+    i_sum = sum(float(iou_counts(logits[k : k + 1], labels[k : k + 1])[0]) for k in range(4))
+    u_sum = sum(float(iou_counts(logits[k : k + 1], labels[k : k + 1])[1]) for k in range(4))
+    assert float(i_all) == i_sum and float(u_all) == u_sum
+
+
+def test_metrics_dict_keys():
+    logits = jnp.zeros((1, 4, 4, 1))
+    labels = jnp.ones((1, 4, 4, 1))
+    m = segmentation_metrics(logits, labels)
+    assert set(m) == {"loss", "pixel_acc", "iou", "iou_inter", "iou_union"}
+
+
+def test_metrics_reduce_in_f32_under_bf16_inputs():
+    logits = jnp.zeros((1, 4, 4, 1), jnp.bfloat16)
+    labels = jnp.ones((1, 4, 4, 1), jnp.bfloat16)
+    m = segmentation_metrics(logits, labels)
+    assert m["loss"].dtype == jnp.float32

@@ -1,0 +1,112 @@
+"""ResUNet shape/structure parity with SURVEY.md §2.3."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fedcrack_tpu.configs import ModelConfig
+from fedcrack_tpu.models import ResUNet, get_model
+from fedcrack_tpu.models.resunet import init_variables, predict, upsample2x
+
+
+@pytest.fixture(scope="module")
+def variables():
+    return init_variables(jax.random.key(0))
+
+
+def test_output_shape_matches_mask(variables):
+    """128x128x3 in -> 128x128x1 logits out (full-resolution masks)."""
+    model = ResUNet()
+    x = jnp.zeros((2, 128, 128, 3))
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 128, 128, 1)
+
+
+def test_bottleneck_spatial_bookkeeping():
+    """Stem /2 and three pools /2: 128 -> 8 at the bottleneck (SURVEY §2.3)."""
+    assert 128 // 2 // 2 // 2 // 2 == 8
+
+
+def test_train_mode_updates_batch_stats(variables):
+    model = ResUNet()
+    x = jax.random.normal(jax.random.key(1), (2, 128, 128, 3))
+    logits, mutated = model.apply(
+        variables, x, train=True, mutable=["batch_stats"]
+    )
+    assert logits.shape == (2, 128, 128, 1)
+    # running stats must actually move
+    old = jax.tree_util.tree_leaves(variables["batch_stats"])
+    new = jax.tree_util.tree_leaves(mutated["batch_stats"])
+    assert any(
+        not jnp.allclose(o, n) for o, n in zip(old, new)
+    ), "batch_stats unchanged in train mode"
+
+
+def test_param_structure_matches_reference_layer_inventory(variables):
+    """One stem, 3 encoder blocks, 4 decoder blocks, 1 head (client_fit_model.py:92-150)."""
+    params = variables["params"]
+    names = set(params.keys())
+    assert "stem_conv" in names and "stem_bn" in names and "head" in names
+    for i in range(3):
+        for suffix in ("sep1", "bn1", "sep2", "bn2", "res"):
+            assert f"enc{i}_{suffix}" in names, f"missing enc{i}_{suffix}"
+    for i in range(4):
+        for suffix in ("convT1", "bn1", "convT2", "bn2", "res"):
+            assert f"dec{i}_{suffix}" in names, f"missing dec{i}_{suffix}"
+    # encoder separable convs: depthwise has no bias, pointwise does (Keras parity)
+    sep = params["enc0_sep1"]
+    assert "bias" not in sep["depthwise"]
+    assert "bias" in sep["pointwise"]
+
+
+def test_param_count_matches_keras_reference(variables):
+    """The Keras builder reports 2,054,369 trainable params + 3,776 BN moving
+    stats for this net (measured by building client_fit_model.py:92-150's
+    architecture in Keras)."""
+    n_params = sum(p.size for p in jax.tree_util.tree_leaves(variables["params"]))
+    n_stats = sum(p.size for p in jax.tree_util.tree_leaves(variables["batch_stats"]))
+    assert n_params == 2_054_369, f"got {n_params}"
+    assert n_stats == 3_776, f"got {n_stats}"
+
+
+def test_predict_in_unit_interval(variables):
+    x = jax.random.normal(jax.random.key(2), (1, 128, 128, 3))
+    probs = predict(variables, x)
+    assert probs.shape == (1, 128, 128, 1)
+    assert float(probs.min()) >= 0.0 and float(probs.max()) <= 1.0
+
+
+def test_upsample2x_nearest():
+    x = jnp.arange(4.0).reshape(1, 2, 2, 1)
+    y = upsample2x(x)
+    assert y.shape == (1, 4, 4, 1)
+    assert float(y[0, 0, 0, 0]) == 0.0 and float(y[0, 1, 1, 0]) == 0.0
+    assert float(y[0, 3, 3, 0]) == 3.0
+
+
+def test_registry_accepts_legacy_alias():
+    """The reference advertises 'mobilenet_v2' (fl_server.py:75) but means the U-Net."""
+    m = get_model("mobilenet_v2")
+    assert isinstance(m, ResUNet)
+    with pytest.raises(KeyError):
+        get_model("resnet50")
+
+
+def test_bf16_compute_f32_params():
+    cfg = ModelConfig(compute_dtype="bfloat16")
+    v = init_variables(jax.random.key(0), cfg)
+    leaves = jax.tree_util.tree_leaves(v["params"])
+    assert all(p.dtype == jnp.float32 for p in leaves)
+    model = ResUNet(config=cfg)
+    logits = model.apply(v, jnp.zeros((1, 128, 128, 3)), train=False)
+    assert logits.dtype == jnp.float32  # head promotes to f32 for the loss
+
+
+def test_jit_compiles_once_static_shapes(variables):
+    model = ResUNet()
+    fn = jax.jit(lambda v, x: model.apply(v, x, train=False))
+    x = jnp.zeros((1, 128, 128, 3))
+    fn(variables, x).block_until_ready()
+    assert fn._cache_size() == 1
+    fn(variables, x + 1).block_until_ready()
+    assert fn._cache_size() == 1
