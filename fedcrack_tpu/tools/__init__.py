@@ -1,0 +1,1 @@
+from fedcrack_tpu.tools.quantify import CrackStats, quantify_mask  # noqa: F401

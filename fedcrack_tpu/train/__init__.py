@@ -1,0 +1,8 @@
+from fedcrack_tpu.train.local import (  # noqa: F401
+    TrainState,
+    create_train_state,
+    eval_step,
+    evaluate,
+    local_fit,
+    train_step,
+)

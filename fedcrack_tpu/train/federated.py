@@ -1,0 +1,58 @@
+"""Bridges the local TPU trainer into the federated client driver.
+
+``make_train_fn`` adapts serialized weight blobs (the control plane's
+currency) to :class:`TrainState` (the jitted trainer's currency): inject the
+round's global weights, reset the optimizer (the reference rebuilds and
+recompiles the whole Keras model every round, client_fit_model.py:155-157 —
+here only the Adam moments reset and the compiled step is reused), run
+``local_epochs`` of SGD, and hand back the trained variables + sample count
+for FedAvg weighting.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import jax
+
+from fedcrack_tpu.configs import FedConfig
+from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
+from fedcrack_tpu.train.local import TrainState, create_train_state, local_fit
+
+
+def reset_optimizer(state: TrainState) -> TrainState:
+    """Fresh Adam moments for a new round's local fit."""
+    return state.replace(opt_state=state.tx.init(state.params))
+
+
+def make_train_fn(
+    config: FedConfig,
+    dataset: Iterable,
+    batch_size: int,
+    seed: int = 0,
+):
+    """Returns ``train_fn(blob, round) -> (blob, sample_count, metrics)`` plus
+    a handle to read the latest :class:`TrainState` (for final-round
+    prediction)."""
+    state = create_train_state(
+        jax.random.key(seed), config.model, config.learning_rate
+    )
+    template = state.variables
+    holder = {"state": state}
+
+    def train_fn(blob: bytes, rnd: int) -> tuple[bytes, int, dict[str, float]]:
+        variables = tree_from_bytes(blob, template=template)
+        st = holder["state"].replace_variables(variables)
+        st = reset_optimizer(st)
+        st, metrics = local_fit(
+            st,
+            dataset,
+            epochs=config.local_epochs,
+            mu=config.fedprox_mu,
+            anchor_params=st.params,
+        )
+        holder["state"] = st
+        n_samples = int(metrics.pop("num_steps", 0) * batch_size)
+        return tree_to_bytes(st.variables), n_samples, metrics
+
+    return train_fn, holder

@@ -1,0 +1,2 @@
+from fedcrack_tpu.transport.client import FedClient  # noqa: F401
+from fedcrack_tpu.transport.service import FedServer  # noqa: F401

@@ -1,0 +1,183 @@
+"""The federated client driver.
+
+The reference's client lifecycle is one 100-line function driving six RPC
+call sites with hardcoded sleeps (reference: fl_client.py:77-175, SURVEY.md
+§3.2). Here the same phases — enroll → pull → train → report → poll/advance —
+are a small loop around an injected ``train_fn``, so the driver is testable
+with a fake trainer and the real TPU trainer plugs in unchanged.
+
+Each control message is one short-lived call on the shared bidi method
+(mirroring the reference's usage pattern of one ``stub.transport(...)`` per
+message). Transient channel errors retry with backoff — the reference
+crashed on any hiccup.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import grpc
+
+from fedcrack_tpu.configs import FedConfig
+from fedcrack_tpu.fed import rounds as R
+from fedcrack_tpu.transport import transport_pb2 as pb
+from fedcrack_tpu.transport.codec import decode_scalar_map, encode_scalar_map
+from fedcrack_tpu.transport.service import METHOD, SERVICE_NAME, channel_options
+
+log = logging.getLogger("fedcrack.client")
+
+# train_fn(weights_blob, round) -> (weights_blob, sample_count, metrics)
+TrainFn = Callable[[bytes, int], tuple[bytes, int, dict[str, float]]]
+
+
+@dataclass
+class SessionResult:
+    cname: str
+    rounds_completed: int = 0
+    final_weights: bytes | None = None
+    enrolled: bool = False
+    history: list[dict] = field(default_factory=list)
+
+
+class FedClient:
+    def __init__(
+        self,
+        config: FedConfig,
+        train_fn: TrainFn,
+        cname: str | None = None,
+        port: int | None = None,
+        poll_period_s: float | None = None,
+        max_retries: int = 5,
+        call_timeout_s: float = 300.0,
+    ):
+        self.config = config
+        self.train_fn = train_fn
+        # unique by construction — the reference drew client{randint(1,100000)}
+        # with possible collisions (fl_client.py:26)
+        self.cname = cname or f"client-{uuid.uuid4().hex[:8]}"
+        self.port = port if port is not None else config.port
+        self.poll_period_s = (
+            poll_period_s if poll_period_s is not None else config.poll_period_s
+        )
+        self.max_retries = max_retries
+        self.call_timeout_s = call_timeout_s
+
+    # -- wire helpers --
+
+    def _connect(self) -> tuple[grpc.Channel, Any]:
+        channel = grpc.insecure_channel(
+            f"{self.config.host}:{self.port}",
+            options=channel_options(self.config.max_message_mb),
+        )
+        method = channel.stream_stream(
+            f"/{SERVICE_NAME}/{METHOD}",
+            request_serializer=pb.ClientMessage.SerializeToString,
+            response_deserializer=pb.ServerMessage.FromString,
+        )
+        return channel, method
+
+    def _call(self, method, msg: pb.ClientMessage) -> pb.ServerMessage:
+        delay = 0.2
+        for attempt in range(self.max_retries):
+            try:
+                # wait_for_ready rides out a server that is still importing
+                # JAX / building its global model before binding the port
+                responses = method(
+                    iter([msg]),
+                    timeout=self.call_timeout_s,
+                    wait_for_ready=True,
+                )
+                for resp in responses:
+                    return resp
+                raise RuntimeError("stream closed without a reply")
+            except grpc.RpcError as e:
+                if attempt == self.max_retries - 1:
+                    raise
+                log.warning("rpc failed (%s); retrying in %.1fs", e.code(), delay)
+                time.sleep(delay)
+                delay = min(delay * 2, 5.0)
+        raise AssertionError("unreachable")
+
+    def _msg(self) -> pb.ClientMessage:
+        return pb.ClientMessage(cname=self.cname)
+
+    # -- the session --
+
+    def run_session(self) -> SessionResult:
+        result = SessionResult(cname=self.cname)
+        channel, method = self._connect()
+        try:
+            # Phase 1: enroll (reference 'R', fl_client.py:84-96)
+            msg = self._msg()
+            msg.ready.SetInParent()
+            encode_scalar_map(msg.ready.config, {"current_round": 0})
+            rep = self._call(method, msg)
+            cfg = decode_scalar_map(rep.config)
+            if rep.status != R.SW:
+                log.info("%s not enrolled: %s", self.cname, rep.status)
+                return result
+            result.enrolled = True
+            current_round = int(cfg["current_round"])
+            max_rounds = int(cfg["max_train_round"])
+            model_version = int(cfg["model_version"])
+
+            # Phase 2: pull global weights (reference 'P', fl_client.py:99-102)
+            msg = self._msg()
+            msg.pull.SetInParent()
+            weights = self._call(method, msg).weights
+
+            while True:
+                # Phase 3: announce training (reference 'T', fl_client.py:106-107)
+                msg = self._msg()
+                msg.training.round = current_round
+                self._call(method, msg)
+
+                # Phase 4: local fit (reference: manage_train, §3.3)
+                weights, n_samples, metrics = self.train_fn(weights, current_round)
+                result.history.append({"round": current_round, **metrics})
+
+                # Phase 5: report (reference 'D', fl_client.py:124-127)
+                msg = self._msg()
+                msg.done.round = current_round
+                msg.done.weights = weights
+                msg.done.sample_count = n_samples
+                encode_scalar_map(
+                    msg.done.metrics,
+                    {k: float(v) for k, v in metrics.items()},
+                )
+                rep = self._call(method, msg)
+
+                if rep.status == R.RESP_ACY:
+                    rep = self._poll(method, model_version, current_round)
+                if rep.status == R.REJECTED:
+                    raise RuntimeError(
+                        f"server rejected update: {decode_scalar_map(rep.config)}"
+                    )
+                # RESP_ARY / NOT_WAIT / FIN all carry the round average
+                if rep.weights:
+                    weights = rep.weights
+                result.rounds_completed = current_round
+                cfg = decode_scalar_map(rep.config)
+                if rep.status == R.FIN or current_round >= max_rounds:
+                    result.final_weights = weights
+                    return result
+                current_round = int(cfg["current_round"])
+                model_version = int(cfg["model_version"])
+        finally:
+            channel.close()
+
+    def _poll(self, method, model_version: int, current_round: int) -> pb.ServerMessage:
+        """Version-poll until the round closes (reference: 20 s loop,
+        fl_client.py:136-155)."""
+        while True:
+            time.sleep(self.poll_period_s)
+            msg = self._msg()
+            msg.poll.model_version = model_version
+            msg.poll.round = current_round
+            rep = self._call(method, msg)
+            if rep.status != R.WAIT:
+                return rep

@@ -1,0 +1,81 @@
+"""Protobuf <-> state-machine translation.
+
+The transport layer is a dumb adapter: every inbound ``ClientMessage``
+becomes exactly one ``fed.rounds`` event (stamped with the server clock),
+and every ``Reply`` becomes one ``ServerMessage``. All protocol logic lives
+in ``fed/rounds.py``; nothing here inspects state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from fedcrack_tpu.fed import rounds as R
+from fedcrack_tpu.transport import transport_pb2 as pb
+
+
+def encode_scalar_map(target, values: Mapping[str, Any]) -> None:
+    """Fill a proto map<string, Scalar> from a python dict."""
+    for key, val in values.items():
+        scalar = target[key]
+        if isinstance(val, bool):
+            scalar.as_bool = val
+        elif isinstance(val, int):
+            scalar.as_int = val
+        elif isinstance(val, float):
+            scalar.as_double = val
+        elif isinstance(val, str):
+            scalar.as_string = val
+        elif isinstance(val, bytes):
+            scalar.as_bytes = val
+        else:
+            raise TypeError(f"unsupported scalar {key}={val!r} ({type(val).__name__})")
+
+
+def decode_scalar_map(source) -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    for key, scalar in source.items():
+        kind = scalar.WhichOneof("value")
+        out[key] = getattr(scalar, kind) if kind else None
+    return out
+
+
+def event_from_message(msg: pb.ClientMessage, now: float) -> R.Event:
+    """One inbound proto message -> one state-machine event."""
+    kind = msg.WhichOneof("msg")
+    cname = msg.cname
+    if kind == "ready":
+        return R.Ready(cname=cname, now=now)
+    if kind == "pull":
+        return R.PullWeights(cname=cname, now=now)
+    if kind == "training":
+        return R.TrainingNotice(cname=cname, now=now)
+    if kind == "log":
+        return R.LogChunk(cname=cname, title=msg.log.title, data=msg.log.data, now=now)
+    if kind == "done":
+        return R.TrainDone(
+            cname=cname,
+            round=msg.done.round,
+            blob=msg.done.weights,
+            num_samples=msg.done.sample_count,
+            now=now,
+        )
+    if kind == "poll":
+        return R.VersionPoll(
+            cname=cname,
+            model_version=msg.poll.model_version,
+            round=msg.poll.round,
+            now=now,
+        )
+    raise ValueError(f"empty or unknown ClientMessage (oneof={kind!r})")
+
+
+def message_from_reply(reply: R.Reply) -> pb.ServerMessage:
+    out = pb.ServerMessage(status=reply.status)
+    if reply.config:
+        encode_scalar_map(out.config, reply.config)
+    if reply.blob is not None:
+        out.weights = reply.blob
+    if reply.title is not None:
+        out.title = reply.title
+    return out

@@ -1,0 +1,172 @@
+"""The gRPC control-plane server.
+
+Replaces the reference's threaded servicer + global mutable state
+(reference: fl_server.py:209-226 — a 10-thread executor mutating module
+globals with no locks, SURVEY.md §2.2(6)) with an asyncio server whose only
+shared state is the immutable ``ServerState``, advanced under one lock: a
+single-writer round machine by construction. The weight payloads on this
+plane are msgpack pytrees; on a TPU pod the data plane moves to ICI
+collectives (``fedcrack_tpu.parallel``) and this server carries control
+traffic only.
+
+The service is bound by hand (no grpc_python_plugin codegen): one
+stream-stream method handler registered under the proto's full name.
+Both send and receive caps are raised — the reference's send cap was lost
+to a ``'grcp.'`` typo (fl_server.py:215, SURVEY.md §2.2(7)).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, AsyncIterator, Callable
+
+import grpc
+
+from fedcrack_tpu.configs import FedConfig
+from fedcrack_tpu.fed import rounds as R
+from fedcrack_tpu.transport import transport_pb2 as pb
+from fedcrack_tpu.transport.codec import event_from_message, message_from_reply
+
+log = logging.getLogger("fedcrack.server")
+
+SERVICE_NAME = "fedcrack.FedControl"
+METHOD = "Session"
+
+
+def channel_options(max_message_mb: int) -> list[tuple[str, int]]:
+    cap = max_message_mb * 1024 * 1024
+    return [
+        ("grpc.max_send_message_length", cap),
+        ("grpc.max_receive_message_length", cap),
+    ]
+
+
+class FedServer:
+    """Owns the round state machine and serves it over gRPC."""
+
+    def __init__(
+        self,
+        config: FedConfig,
+        global_variables: Any,
+        clock: Callable[[], float] = time.monotonic,
+        tick_period_s: float = 1.0,
+    ):
+        self.config = config
+        self.state = R.initial_state(config, global_variables)
+        self._clock = clock
+        self._tick_period_s = tick_period_s
+        self._lock = asyncio.Lock()
+        self._server: grpc.aio.Server | None = None
+        self._tick_task: asyncio.Task | None = None
+        self.bound_port: int | None = None
+        self.finished = asyncio.Event()
+
+    # -- state advancement (the only two writers, both under the lock) --
+
+    async def _apply(self, event: R.Event) -> R.Reply:
+        async with self._lock:
+            self.state, reply = R.transition(self.state, event)
+            if self.state.phase == R.PHASE_FINISHED:
+                self.finished.set()
+            return reply
+
+    async def _tick_forever(self) -> None:
+        """Drives pure time effects: enrollment-window close and round
+        deadlines (the reference used a background Timer thread mutating
+        globals, fl_server.py:40-52)."""
+        while True:
+            await asyncio.sleep(self._tick_period_s)
+            await self._apply(R.Tick(now=self._clock()))
+
+    # -- gRPC plumbing --
+
+    async def _session(
+        self, request_iterator: AsyncIterator[pb.ClientMessage], context
+    ) -> AsyncIterator[pb.ServerMessage]:
+        async for msg in request_iterator:
+            try:
+                event = event_from_message(msg, now=self._clock())
+            except (ValueError, TypeError) as e:
+                yield pb.ServerMessage(status=R.REJECTED, title=str(e))
+                continue
+            reply = await self._apply(event)
+            log.debug("%s -> %s", type(event).__name__, reply.status)
+            yield message_from_reply(reply)
+
+    def _build(self) -> grpc.aio.Server:
+        server = grpc.aio.server(options=channel_options(self.config.max_message_mb))
+        handler = grpc.stream_stream_rpc_method_handler(
+            self._session,
+            request_deserializer=pb.ClientMessage.FromString,
+            response_serializer=pb.ServerMessage.SerializeToString,
+        )
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE_NAME, {METHOD: handler}),)
+        )
+        self.bound_port = server.add_insecure_port(
+            f"{self.config.host}:{self.config.port}"
+        )
+        return server
+
+    async def start(self) -> int:
+        """Bind + serve; returns the bound port (0 in config -> ephemeral)."""
+        self._server = self._build()
+        await self._server.start()
+        self._tick_task = asyncio.create_task(self._tick_forever())
+        log.info("serving on %s:%s", self.config.host, self.bound_port)
+        return self.bound_port
+
+    async def stop(self, grace: float = 1.0) -> None:
+        if self._tick_task is not None:
+            self._tick_task.cancel()
+        if self._server is not None:
+            await self._server.stop(grace)
+
+    async def serve_until_finished(self, extra_grace_s: float = 5.0) -> R.ServerState:
+        """Run a full federation: serve until the round machine reaches FIN,
+        linger briefly so clients can pull the final weights, then stop."""
+        await self.start()
+        await self.finished.wait()
+        await asyncio.sleep(extra_grace_s)
+        await self.stop()
+        return self.state
+
+
+class ServerThread:
+    """Runs a :class:`FedServer` on its own asyncio loop in a daemon thread —
+    the in-process harness for tests, benchmarks and notebooks."""
+
+    def __init__(self, server: FedServer):
+        import threading
+
+        self.server = server
+        self.loop = asyncio.new_event_loop()
+        self.port: int | None = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        self.port = self.loop.run_until_complete(self.server.start())
+        self._started.set()
+        self.loop.run_forever()
+
+    def __enter__(self) -> "ServerThread":
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("server failed to start")
+        return self
+
+    def __exit__(self, *exc) -> None:
+        fut = asyncio.run_coroutine_threadsafe(self.server.stop(grace=0.5), self.loop)
+        try:
+            fut.result(timeout=5)
+        finally:
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self._thread.join(timeout=5)
+
+    @property
+    def state(self) -> R.ServerState:
+        return self.server.state

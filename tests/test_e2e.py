@@ -1,0 +1,95 @@
+"""End-to-end: 2 real U-Net clients federate over localhost gRPC.
+
+This is SURVEY.md §7's "minimum slice B" (BASELINE.md config 2) shrunk for
+CI: real Flax model, real jitted local fit, real msgpack weights on the wire,
+real FedAvg rounds — tiny shapes (32px, 8 imgs/client, 1 local epoch,
+2 rounds)."""
+
+import dataclasses
+import threading
+
+import numpy as np
+import pytest
+
+from fedcrack_tpu.configs import DataConfig, FedConfig, ModelConfig
+from fedcrack_tpu.data.pipeline import ArrayDataset
+from fedcrack_tpu.data.synthetic import synth_crack_batch
+from fedcrack_tpu.fed import rounds as R
+from fedcrack_tpu.fed.serialization import tree_from_bytes
+from fedcrack_tpu.train.federated import make_train_fn
+from fedcrack_tpu.transport import FedClient, FedServer
+from fedcrack_tpu.transport.service import ServerThread
+
+
+@pytest.mark.slow
+def test_two_real_clients_federate():
+    cfg = FedConfig(
+        max_rounds=2,
+        cohort_size=2,
+        local_epochs=1,
+        registration_window_s=10.0,
+        poll_period_s=0.1,
+        host="127.0.0.1",
+        port=0,
+        model=ModelConfig(img_size=32),
+        data=DataConfig(img_size=32, batch_size=4),
+    )
+
+    def make_client(name: str, seed: int):
+        images, masks = synth_crack_batch(8, 32, seed=seed)
+        ds = ArrayDataset(images, masks, batch_size=4, seed=seed)
+        train_fn, holder = make_train_fn(cfg, ds, batch_size=4, seed=seed)
+        return FedClient(cfg, train_fn, cname=name), holder
+
+    import jax
+
+    from fedcrack_tpu.train.local import create_train_state
+
+    server_state0 = create_train_state(jax.random.key(0), cfg.model)
+    server = FedServer(cfg, server_state0.variables, tick_period_s=0.1)
+
+    with ServerThread(server) as st:
+        cfg_bound = dataclasses.replace(cfg, port=st.port)
+        results = {}
+
+        def run(name, seed):
+            client, _ = make_client(name, seed)
+            client.port = st.port
+            results[name] = client.run_session()
+
+        threads = [
+            threading.Thread(target=run, args=("a", 1)),
+            threading.Thread(target=run, args=("b", 2)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        state = st.state
+
+    assert state.phase == R.PHASE_FINISHED
+    assert len(state.history) == 2
+    for name in ("a", "b"):
+        r = results[name]
+        assert r.enrolled and r.rounds_completed == 2
+        assert all(np.isfinite(h["loss"]) for h in r.history)
+
+    # the broadcast final weights equal the server's global average
+    final = tree_from_bytes(state.global_blob)
+    for name in ("a", "b"):
+        client_final = tree_from_bytes(results[name].final_weights)
+        for lc, ls in zip(_leaves(client_final), _leaves(final)):
+            assert np.allclose(lc, ls, atol=1e-6)
+
+    # the global model actually moved away from its initialization
+    init_leaves = _leaves(server_state0.variables["params"])
+    final_leaves = _leaves(final["params"])
+    assert any(
+        not np.allclose(i, f, atol=1e-7) for i, f in zip(init_leaves, final_leaves)
+    )
+
+
+def _leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)

@@ -1,0 +1,204 @@
+"""Federation logic: serialization round-trip, FedAvg math, state machine."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedcrack_tpu.configs import FedConfig
+from fedcrack_tpu.fed import fedavg, fedprox_penalty, tree_from_bytes, tree_to_bytes
+from fedcrack_tpu.fed import rounds as R
+
+
+# ---------- serialization ----------
+
+def _tree(seed):
+    rng = np.random.default_rng(seed)
+    return {
+        "layer": {"kernel": rng.normal(size=(3, 4)).astype(np.float32)},
+        "bias": rng.normal(size=(4,)).astype(np.float32),
+    }
+
+
+def test_roundtrip_exact():
+    t = _tree(0)
+    out = tree_from_bytes(tree_to_bytes(t))
+    assert np.array_equal(out["layer"]["kernel"], t["layer"]["kernel"])
+    assert np.array_equal(out["bias"], t["bias"])
+
+
+def test_roundtrip_with_template_restores_dtype():
+    t = _tree(1)
+    blob = tree_to_bytes(t, cast_dtype="bfloat16")
+    out = tree_from_bytes(blob, template=t)
+    assert out["layer"]["kernel"].dtype == np.float32
+    # bf16 wire precision: ~3 decimal digits
+    assert np.allclose(out["bias"], t["bias"], atol=0.05)
+    # and the wire is half the size
+    assert len(blob) < len(tree_to_bytes(t)) * 0.75
+
+
+def test_leaf_count_mismatch_rejected():
+    t = _tree(2)
+    with pytest.raises(ValueError, match="leaves"):
+        tree_from_bytes(tree_to_bytes({"only": t["bias"]}), template=t)
+
+
+def test_no_pickle_on_the_wire():
+    blob = tree_to_bytes(_tree(3))
+    assert not blob.startswith(b"\x80")  # pickle protocol-2+ magic
+
+
+# ---------- fedavg ----------
+
+def test_fedavg_unweighted_matches_numpy_mean():
+    trees = [_tree(i) for i in range(4)]
+    avg = fedavg(trees)
+    ref = np.mean([t["layer"]["kernel"] for t in trees], axis=0)
+    assert np.allclose(avg["layer"]["kernel"], ref, atol=1e-6)
+
+
+def test_fedavg_weighted_closed_form():
+    trees = [_tree(i) for i in range(2)]
+    avg = fedavg(trees, weights=[3, 1])
+    ref = 0.75 * trees[0]["bias"] + 0.25 * trees[1]["bias"]
+    assert np.allclose(avg["bias"], ref, atol=1e-6)
+
+
+def test_fedavg_rejects_empty_and_bad_weights():
+    with pytest.raises(ValueError):
+        fedavg([])
+    with pytest.raises(ValueError):
+        fedavg([_tree(0)], weights=[1, 2])
+    with pytest.raises(ValueError):
+        fedavg([_tree(0), _tree(1)], weights=[0, 0])
+
+
+def test_fedprox_penalty_closed_form():
+    a = {"w": jnp.ones((2, 2))}
+    b = {"w": jnp.zeros((2, 2))}
+    assert float(fedprox_penalty(a, b, mu=0.1)) == pytest.approx(0.5 * 0.1 * 4.0)
+
+
+# ---------- round state machine ----------
+
+CFG = FedConfig(max_rounds=2, cohort_size=2, registration_window_s=10.0)
+
+
+def boot(cfg=CFG):
+    return R.initial_state(cfg, _tree(42))
+
+
+def enroll_two(state, t0=0.0):
+    state, r1 = R.transition(state, R.Ready("a", now=t0))
+    assert r1.status == R.SW
+    state, r2 = R.transition(state, R.Ready("b", now=t0 + 1))
+    assert r2.status == R.SW
+    assert r2.config["max_train_round"] == state.config.max_rounds
+    assert r2.config["model_type"] == "resunet"
+    return state
+
+
+def done(state, cname, rnd, seed, now, ns=8):
+    return R.transition(
+        state, R.TrainDone(cname, round=rnd, blob=tree_to_bytes(_tree(seed)), num_samples=ns, now=now)
+    )
+
+
+def test_full_session_two_clients_two_rounds():
+    state = enroll_two(boot())
+    # round 1: a reports first -> ACY; b completes the round -> ARY + avg blob
+    state, ra = done(state, "a", 1, seed=1, now=2.0)
+    assert ra.status == R.RESP_ACY
+    state, rb = done(state, "b", 1, seed=2, now=3.0)
+    assert rb.status == R.RESP_ARY
+    avg = tree_from_bytes(rb.blob)
+    expect = np.mean([_tree(1)["bias"], _tree(2)["bias"]], axis=0)
+    assert np.allclose(avg["bias"], expect, atol=1e-6)  # broadcast == average (fix #1)
+    assert state.current_round == 2 and state.model_version == 1
+    assert not state.received  # buffer reset (fix #2)
+    # round 2 -> FIN
+    state, _ = done(state, "a", 2, seed=3, now=4.0)
+    state, rfin = done(state, "b", 2, seed=4, now=5.0)
+    assert rfin.status == R.FIN
+    assert state.phase == R.PHASE_FINISHED
+    assert len(state.history) == 2
+
+
+def test_weighted_aggregation_by_sample_count():
+    state = enroll_two(boot())
+    state, _ = done(state, "a", 1, seed=1, now=2.0, ns=30)
+    state, rb = done(state, "b", 1, seed=2, now=3.0, ns=10)
+    avg = tree_from_bytes(rb.blob)
+    expect = 0.75 * _tree(1)["bias"] + 0.25 * _tree(2)["bias"]
+    assert np.allclose(avg["bias"], expect, atol=1e-6)
+
+
+def test_late_client_gets_ctw():
+    state = enroll_two(boot())
+    # 11 s after first ready: window closed on next event
+    state, r = R.transition(state, R.Ready("late", now=12.0))
+    assert r.status == R.CTW
+    assert "late" not in state.cohort
+
+
+def test_stale_round_rejected_not_crash():
+    state = enroll_two(boot())
+    state, r = done(state, "a", 99, seed=1, now=2.0)
+    assert r.status == R.REJECTED
+    assert r.config["reason"] == "stale round"
+    state, r = done(state, "stranger", 1, seed=1, now=2.0)
+    assert r.status == R.REJECTED
+
+
+def test_version_poll_wait_then_not_wait():
+    state = enroll_two(boot())
+    state, r = R.transition(state, R.VersionPoll("a", model_version=0, round=1, now=2.0))
+    assert r.status == R.WAIT
+    state, _ = done(state, "a", 1, seed=1, now=2.5)
+    state, rb = done(state, "b", 1, seed=2, now=3.0)
+    state, r = R.transition(state, R.VersionPoll("a", model_version=0, round=1, now=3.5))
+    assert r.status == R.NOT_WAIT
+    assert np.array_equal(
+        tree_from_bytes(r.blob)["bias"], tree_from_bytes(rb.blob)["bias"]
+    )
+
+
+def test_pull_weights_returns_current_global():
+    state = enroll_two(boot())
+    _, r = R.transition(state, R.PullWeights("a", now=2.0))
+    assert np.array_equal(tree_from_bytes(r.blob)["bias"], _tree(42)["bias"])
+    # after round 1 the pull must return the average, not the init weights
+    state, _ = done(state, "a", 1, seed=1, now=2.0)
+    state, _ = done(state, "b", 1, seed=2, now=3.0)
+    _, r2 = R.transition(state, R.PullWeights("a", now=4.0))
+    assert not np.array_equal(tree_from_bytes(r2.blob)["bias"], _tree(42)["bias"])
+
+
+def test_deadline_shrinks_cohort():
+    cfg = dataclasses.replace(CFG, round_deadline_s=30.0, max_rounds=3)
+    state = enroll_two(boot(cfg))
+    state, _ = done(state, "a", 1, seed=1, now=2.0)
+    # b never reports; deadline passes
+    state, _ = R.transition(state, R.Tick(now=50.0))
+    assert state.cohort == frozenset({"a"})
+    assert state.current_round == 2  # aggregated from a alone
+    avg = R.transition(state, R.PullWeights("a", now=51.0))[1]
+    assert np.allclose(tree_from_bytes(avg.blob)["bias"], _tree(1)["bias"], atol=1e-6)
+
+
+def test_log_chunks_accumulate():
+    state = enroll_two(boot())
+    state, r = R.transition(state, R.LogChunk("a", "events.tb", b"abc", now=2.0))
+    state, r = R.transition(state, R.LogChunk("a", "events.tb", b"def", now=2.1))
+    assert state.logs["a/events.tb"] == b"abcdef"
+
+
+def test_single_writer_purity_no_shared_mutation():
+    """Transitions never mutate the input state (regression for the
+    reference's cross-thread mutation bugs, SURVEY.md §2.2(6))."""
+    s0 = boot()
+    s1, _ = R.transition(s0, R.Ready("a", now=0.0))
+    assert s0.cohort == frozenset() and s1.cohort == {"a"}

@@ -1,0 +1,68 @@
+"""Crack quantifier: closed-form shapes and the predict flow."""
+
+import numpy as np
+
+from fedcrack_tpu.tools import quantify_mask
+from fedcrack_tpu.tools.quantify import annotate
+
+
+def test_single_square_crack():
+    mask = np.zeros((64, 64), np.uint8)
+    mask[20:40, 20:40] = 255  # 20x20 square
+    s = quantify_mask(mask)
+    assert s.contour_count == 1
+    # cv2 contour area of a filled 20x20 block is (19)^2 (contour runs on
+    # pixel centers); perimeter ~ 4*19
+    assert abs(s.total_area_px - 361) < 2
+    assert abs(s.total_perimeter_px - 76) < 2
+    c = s.contours[0]
+    assert c.approx_points_10pct == 4  # a square simplifies to 4 vertices
+    assert abs(s.crack_fraction - 400 / 4096) < 1e-6
+
+
+def test_empty_mask():
+    s = quantify_mask(np.zeros((32, 32), np.uint8))
+    assert s.contour_count == 0 and s.total_area_px == 0
+
+
+def test_float01_mask_accepted():
+    mask = np.zeros((32, 32), np.float32)
+    mask[8:16, 8:24] = 1.0
+    s = quantify_mask(mask)
+    assert s.contour_count == 1
+
+
+def test_two_separate_cracks():
+    mask = np.zeros((64, 64), np.uint8)
+    mask[5:15, 5:15] = 255
+    mask[40:60, 40:50] = 255
+    s = quantify_mask(mask)
+    assert s.contour_count == 2
+
+
+def test_annotate_returns_uint8_rgb():
+    img = np.random.default_rng(0).uniform(size=(32, 32, 3)).astype(np.float32)
+    mask = np.zeros((32, 32), np.uint8)
+    mask[10:20, 10:20] = 255
+    out = annotate(img, mask)
+    assert out.dtype == np.uint8 and out.shape == (32, 32, 3)
+    assert (out != (np.clip(img, 0, 1) * 255).astype(np.uint8)).any()
+
+
+def test_predict_and_quantify_writes_outputs(tmp_path):
+    import jax
+
+    from fedcrack_tpu.configs import ModelConfig
+    from fedcrack_tpu.data.pipeline import ArrayDataset
+    from fedcrack_tpu.data.synthetic import synth_crack_batch
+    from fedcrack_tpu.tools.quantify import predict_and_quantify
+    from fedcrack_tpu.train import create_train_state
+
+    state = create_train_state(jax.random.key(0), ModelConfig(img_size=32))
+    images, masks = synth_crack_batch(4, 32, seed=0)
+    ds = ArrayDataset(images, masks, batch_size=2, shuffle=False)
+    reports = predict_and_quantify(state, ds, out_dir=str(tmp_path), max_images=3)
+    assert len(reports) == 3
+    assert (tmp_path / "pred_000.png").exists()
+    assert (tmp_path / "overlay_002.png").exists()
+    assert all("area_px" in r for r in reports)

@@ -1,0 +1,194 @@
+"""gRPC control plane: codec mapping and an in-process federated session.
+
+The integration test is the SURVEY.md §4 "in-process server + K fake clients
+over localhost gRPC" check: round count, version monotonicity, and broadcast
+weights == average of uploads (regression tests for the reference bugs
+§2.2(1,2))."""
+
+import dataclasses
+import threading
+
+import numpy as np
+import pytest
+
+from fedcrack_tpu.configs import FedConfig
+from fedcrack_tpu.fed import rounds as R
+from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
+from fedcrack_tpu.transport import FedClient, FedServer
+from fedcrack_tpu.transport import transport_pb2 as pb
+from fedcrack_tpu.transport.codec import (
+    decode_scalar_map,
+    encode_scalar_map,
+    event_from_message,
+    message_from_reply,
+)
+from fedcrack_tpu.transport.service import ServerThread
+
+
+# ---------- codec ----------
+
+def test_scalar_map_roundtrip():
+    msg = pb.ServerMessage()
+    values = {"i": 3, "f": 0.5, "s": "SW", "b": True, "by": b"\x00\x01"}
+    encode_scalar_map(msg.config, values)
+    assert decode_scalar_map(msg.config) == values
+
+
+def test_event_mapping_all_kinds():
+    m = pb.ClientMessage(cname="c")
+    m.ready.SetInParent()
+    assert isinstance(event_from_message(m, 1.0), R.Ready)
+    m = pb.ClientMessage(cname="c")
+    m.pull.SetInParent()
+    assert isinstance(event_from_message(m, 1.0), R.PullWeights)
+    m = pb.ClientMessage(cname="c")
+    m.training.round = 2
+    assert isinstance(event_from_message(m, 1.0), R.TrainingNotice)
+    m = pb.ClientMessage(cname="c")
+    m.log.title = "t"
+    m.log.data = b"d"
+    ev = event_from_message(m, 1.0)
+    assert isinstance(ev, R.LogChunk) and ev.data == b"d"
+    m = pb.ClientMessage(cname="c")
+    m.done.round = 1
+    m.done.weights = b"w"
+    m.done.sample_count = 9
+    ev = event_from_message(m, 1.0)
+    assert isinstance(ev, R.TrainDone) and ev.num_samples == 9
+    m = pb.ClientMessage(cname="c")
+    m.poll.model_version = 1
+    m.poll.round = 2
+    ev = event_from_message(m, 1.0)
+    assert isinstance(ev, R.VersionPoll) and ev.model_version == 1
+    with pytest.raises(ValueError):
+        event_from_message(pb.ClientMessage(cname="c"), 1.0)
+
+
+def test_reply_mapping():
+    out = message_from_reply(
+        R.Reply(status="RESP_ARY", config={"current_round": 2}, blob=b"W", title="p")
+    )
+    assert out.status == "RESP_ARY"
+    assert out.weights == b"W" and out.title == "p"
+    assert decode_scalar_map(out.config)["current_round"] == 2
+
+
+# ---------- integration: K fake clients over localhost ----------
+
+def _vars(value: float):
+    return {"params": {"w": np.full((4, 4), value, np.float32)}}
+
+
+def _fake_train(increment: float, samples: int):
+    """A 'trainer' that adds a constant — makes the expected average exact."""
+
+    def train_fn(blob: bytes, rnd: int):
+        tree = tree_from_bytes(blob)
+        tree["params"]["w"] = tree["params"]["w"] + increment
+        return tree_to_bytes(tree), samples, {"loss": float(rnd)}
+
+    return train_fn
+
+
+@pytest.fixture
+def session_cfg():
+    return FedConfig(
+        max_rounds=3,
+        cohort_size=2,
+        registration_window_s=5.0,
+        poll_period_s=0.05,
+        host="127.0.0.1",
+        port=0,  # ephemeral
+    )
+
+
+def test_two_clients_full_session(session_cfg):
+    server = FedServer(session_cfg, _vars(0.0), tick_period_s=0.05)
+    with ServerThread(server) as st:
+        clients = [
+            FedClient(session_cfg, _fake_train(1.0, 10), cname="a", port=st.port),
+            FedClient(session_cfg, _fake_train(3.0, 30), cname="b", port=st.port),
+        ]
+        results = [None, None]
+        threads = [
+            threading.Thread(target=lambda i=i, c=c: results.__setitem__(i, c.run_session()))
+            for i, c in enumerate(clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        state = st.state
+
+    assert all(r is not None and r.enrolled for r in results)
+    assert all(r.rounds_completed == 3 for r in results)
+    assert state.phase == R.PHASE_FINISHED
+    assert state.current_round == 4 and state.model_version == 3
+    assert len(state.history) == 3
+    # weighted average: (10*(w+1) + 30*(w+3)) / 40 = w + 2.5 each round
+    final = tree_from_bytes(state.global_blob)
+    assert np.allclose(final["params"]["w"], 0.0 + 2.5 * 3, atol=1e-5)
+    # both clients ended with the same (broadcast) weights == server average
+    for r in results:
+        got = tree_from_bytes(r.final_weights)
+        assert np.allclose(got["params"]["w"], final["params"]["w"], atol=1e-5)
+
+
+def test_late_client_turned_away(session_cfg):
+    server = FedServer(session_cfg, _vars(0.0), tick_period_s=0.05)
+    with ServerThread(server) as st:
+        a = FedClient(session_cfg, _fake_train(1.0, 10), cname="a", port=st.port)
+        b = FedClient(session_cfg, _fake_train(1.0, 10), cname="b", port=st.port)
+        ra = [None]
+        rb = [None]
+        ta = threading.Thread(target=lambda: ra.__setitem__(0, a.run_session()))
+        tb = threading.Thread(target=lambda: rb.__setitem__(0, b.run_session()))
+        ta.start()
+        tb.start()
+        ta.join(60)
+        tb.join(60)
+        # cohort full (2) -> enrollment closed -> latecomer gets CTW
+        late = FedClient(session_cfg, _fake_train(1.0, 10), cname="late", port=st.port)
+        rl = late.run_session()
+    assert ra[0].enrolled and rb[0].enrolled
+    assert not rl.enrolled and rl.rounds_completed == 0
+
+
+def test_dead_client_mid_round_cohort_shrinks(session_cfg):
+    """Fault injection (SURVEY.md §5.3): one client dies after round 1; the
+    deadline shrinks the cohort and the survivor finishes alone."""
+    cfg = dataclasses.replace(session_cfg, round_deadline_s=0.5)
+    server = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
+
+    class DiesAfterRound1(Exception):
+        pass
+
+    def dying_train(blob, rnd):
+        if rnd >= 2:
+            raise DiesAfterRound1()
+        return _fake_train(1.0, 10)(blob, rnd)
+
+    with ServerThread(server) as st:
+        a = FedClient(cfg, _fake_train(1.0, 10), cname="a", port=st.port)
+        b = FedClient(cfg, dying_train, cname="b", port=st.port)
+        res = {}
+
+        def run(c, key):
+            try:
+                res[key] = c.run_session()
+            except Exception as e:
+                res[key] = e
+
+        ta = threading.Thread(target=run, args=(a, "a"))
+        tb = threading.Thread(target=run, args=(b, "b"))
+        ta.start()
+        tb.start()
+        ta.join(60)
+        tb.join(60)
+        state = st.state
+
+    assert isinstance(res["b"], DiesAfterRound1)
+    assert not isinstance(res["a"], Exception)
+    assert res["a"].rounds_completed == 3
+    assert state.phase == R.PHASE_FINISHED
+    assert state.cohort == frozenset({"a"})
