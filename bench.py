@@ -1,0 +1,121 @@
+"""Benchmark: FedAvg round wall-clock, mesh data plane vs host control plane.
+
+The north-star metric (BASELINE.md): federated round wall-clock with the
+round executed as ONE compiled XLA program (local-SGD scan + in-mesh FedAvg,
+``fedcrack_tpu.parallel``) versus the reference's architecture reproduced in
+this repo — Python-driven per-step dispatch with per-batch host transfers,
+weights serialized to bytes and averaged on the host (the gRPC weight-shipping
+plane of fl_server.py:92-105 / fl_client.py:63, minus the network).
+
+Prints ONE JSON line: value = mesh-plane round wall-clock (ms);
+vs_baseline = host-plane time / mesh-plane time (higher is better, >1 means
+the TPU-native plane wins).
+
+Run shape: flagship 128x128 U-Net, batch 16 (reference: client_fit_model.py:55-56),
+32 steps, 1 local epoch, as many mesh clients as the host exposes devices.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+
+STEPS = 32
+BATCH = 16
+SEED = 0
+
+
+def _median_time(fn, reps: int = 3) -> float:
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def main() -> None:
+    from fedcrack_tpu.configs import ModelConfig
+    from fedcrack_tpu.data.synthetic import synth_crack_batch
+    from fedcrack_tpu.fed.algorithms import fedavg
+    from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
+    from fedcrack_tpu.parallel import build_federated_round, make_mesh, stack_client_data
+    from fedcrack_tpu.train.local import create_train_state, train_step
+
+    config = ModelConfig()  # 128x128x3 — the reference's training shape
+    n_clients = max(1, jax.device_count())
+    per_client = [
+        synth_crack_batch(STEPS * BATCH, img_size=config.img_size, seed=SEED + i)
+        for i in range(n_clients)
+    ]
+    state0 = create_train_state(jax.random.key(SEED), config)
+    variables = state0.variables
+    n_samples = np.full(n_clients, float(STEPS * BATCH), np.float32)
+    active = np.ones(n_clients, np.float32)
+
+    # ---- mesh plane: the whole round is one program ----
+    mesh = make_mesh(n_clients, 1)
+    round_fn = build_federated_round(mesh, config, learning_rate=1e-3, local_epochs=1)
+    stacked_images, stacked_masks = stack_client_data(per_client, STEPS, BATCH)
+
+    def mesh_round():
+        new_vars, _ = round_fn(
+            variables, stacked_images, stacked_masks, active, n_samples
+        )
+        jax.block_until_ready(new_vars)
+        return new_vars
+
+    # ---- host plane: reference architecture (per-step dispatch + byte
+    # shipping + host-side average), minus the actual TCP socket ----
+    mu0 = np.float32(0.0)
+
+    def host_round():
+        blob = tree_to_bytes(variables)  # server -> client broadcast
+        uploads = []
+        for c in range(n_clients):
+            received = tree_from_bytes(blob, template=variables)
+            st = state0.replace_variables(received)
+            st = st.replace(opt_state=st.tx.init(st.params))
+            images, masks = per_client[c]
+            for s in range(STEPS):
+                batch = (
+                    images[s * BATCH : (s + 1) * BATCH],
+                    masks[s * BATCH : (s + 1) * BATCH],
+                )
+                st, _ = train_step(st, batch, received["params"], mu0)
+            jax.block_until_ready(st.params)
+            uploads.append(tree_to_bytes(st.variables))  # client -> server
+        trees = [tree_from_bytes(b, template=variables) for b in uploads]
+        avg = fedavg(trees, weights=list(n_samples))
+        jax.block_until_ready(avg)
+        return avg
+
+    # Warm up both programs (first TPU compile is slow and cached after).
+    mesh_round()
+    host_round()
+
+    mesh_s = _median_time(mesh_round)
+    host_s = _median_time(host_round)
+
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"FedAvg round wall-clock, one-program mesh plane "
+                    f"({n_clients} client(s), 128x128, b{BATCH}, {STEPS} steps) "
+                    f"vs host/gRPC-style plane"
+                ),
+                "value": round(mesh_s * 1000.0, 2),
+                "unit": "ms",
+                "vs_baseline": round(host_s / mesh_s, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -2,18 +2,24 @@
 
 Multi-chip TPU hardware is not available in CI; JAX's host-platform device
 emulation gives the suite 8 virtual CPU devices so mesh/psum sharding code
-runs for real. Must be set before the first ``import jax``.
+runs for real (SURVEY.md §4 "distributed-without-a-cluster").
+
+NOTE: in this image JAX is pre-imported at interpreter startup (a site hook),
+so ``JAX_PLATFORMS``/``XLA_FLAGS`` environment overrides are captured before
+any conftest runs. The runtime ``jax.config.update`` API is the reliable
+override — it works any time before first backend use.
 """
 
 import os
 import sys
 
+import jax
+
 # Hard override: the container profile exports JAX_PLATFORMS=axon (the real
 # TPU tunnel); the suite must run on the virtual CPU mesh regardless.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
 # Keep TF (used only by h5-importer parity tests) off any accelerator and quiet.
 os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")

@@ -1,0 +1,17 @@
+"""TPU data plane: federated rounds as single compiled XLA programs.
+
+The reference's "collectives" are Python loops over pickled weight lists
+shipped through gRPC (reference: fl_server.py:92-105, fl_client.py:63). Here
+the whole round — K clients' local SGD plus FedAvg aggregation — runs as one
+``shard_map`` program over a ``Mesh(('clients', 'batch'))``: one federated
+client per chip (or chip group), aggregation as a masked ``lax.psum`` over
+the ``clients`` axis riding ICI, gradient data-parallelism as ``lax.pmean``
+over the ``batch`` axis (SURVEY.md §5.8, §7 step 5).
+"""
+
+from fedcrack_tpu.parallel.mesh import make_mesh  # noqa: F401
+from fedcrack_tpu.parallel.fedavg_mesh import (  # noqa: F401
+    build_federated_round,
+    mesh_fedavg,
+    stack_client_data,
+)

@@ -1,0 +1,259 @@
+"""A federated round as ONE compiled XLA program.
+
+The reference runs a round as N processes x (3880 Python-driven Keras steps)
+followed by a server-side numpy loop over pickled weight lists
+(reference: client_fit_model.py:166, fl_server.py:92-105). Here the entire
+round is a single ``shard_map`` over ``Mesh(('clients', 'batch'))``:
+
+- each client's local fit is a ``lax.scan`` over its batches (epochs as an
+  outer scan) — no Python in the loop, one compilation for all rounds;
+- gradients ``lax.pmean`` over the ``batch`` axis (intra-client DP);
+- FedAvg is a **masked, sample-weighted ``lax.psum`` over the ``clients``
+  axis**: dropped-out clients carry ``active=0`` and the divisor is
+  ``psum(active * n_samples)``, so a shrunken cohort needs no recompilation
+  (SURVEY.md §7 "masked/variable cohort psum").
+
+BatchNorm moving statistics are carried per client and averaged with the
+kernels, matching the reference's implicit behavior (``get_weights()``
+includes BN moments — SURVEY.md §7 "hard parts"). Normalization inside the
+step uses per-device batch moments (standard non-sync BN across the DP axis);
+the *running* stats are pmean'd so every replica leaves the round identical.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fedcrack_tpu.configs import ModelConfig
+from fedcrack_tpu.fed.algorithms import fedprox_penalty
+from fedcrack_tpu.models import ResUNet
+from fedcrack_tpu.ops.losses import iou_counts, iou_from_counts, pixel_accuracy, sigmoid_bce
+from fedcrack_tpu.train.local import make_optimizer
+
+CLIENTS, BATCH = "clients", "batch"
+
+
+def _masked_mean_over_clients(tree: Any, weight: jax.Array, denom: jax.Array) -> Any:
+    """Weighted psum-mean over the ``clients`` axis; ``weight`` is this
+    client's ``active * n_samples`` (0 for dropped clients)."""
+
+    def leaf(x):
+        acc = lax.psum(weight * x.astype(jnp.float32), CLIENTS) / denom
+        return acc.astype(x.dtype)
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+def build_federated_round(
+    mesh: Mesh,
+    model_config: ModelConfig | None = None,
+    learning_rate: float = 1e-3,
+    local_epochs: int = 1,
+    fedprox_mu: float = 0.0,
+):
+    """Compile-once round function.
+
+    Returns ``round_fn(variables, images, masks, active, n_samples)``:
+
+    - ``variables``: the global ``{'params', 'batch_stats'}`` pytree
+      (replicated over the mesh);
+    - ``images``  float32 ``[C, steps, B, H, W, 3]``,
+      ``masks``   float32 ``[C, steps, B, H, W, 1]`` — per-client local data,
+      ``C == mesh.shape['clients']``; the per-step batch ``B`` is split over
+      the ``batch`` axis (must divide evenly);
+    - ``active``  float32 ``[C]`` participation mask (1 = reported, 0 =
+      dropped out mid-round);
+    - ``n_samples`` float32 ``[C]`` per-client sample counts (FedAvg
+      weights).
+
+    Returns ``(new_variables, per_client_metrics)`` where metrics leaves are
+    ``[C]`` arrays from each client's final local epoch. Adam state is fresh
+    each round (the reference rebuilds its model per round,
+    client_fit_model.py:155-157; here only the optimizer moments reset).
+    """
+    model_config = model_config or ModelConfig()
+    model = ResUNet(config=model_config)
+    tx = make_optimizer(learning_rate)
+    mu = float(fedprox_mu)
+    n_client_shards = mesh.shape[CLIENTS]
+
+    def client_fit(variables, images, masks, active, n_samples):
+        # Per-shard blocks: leading clients-axis block is exactly one client.
+        images, masks = images[0], masks[0]          # [steps, B_local, H, W, ch]
+        active_i, n_i = active[0], n_samples[0]
+        params = variables["params"]
+        batch_stats = variables["batch_stats"]
+        anchor = params  # FedProx anchor = this round's global weights
+        opt_state = tx.init(params)
+        mu_arr = jnp.asarray(mu, jnp.float32)
+
+        def sgd_step(carry, batch):
+            params, batch_stats, opt_state = carry
+            imgs, msks = batch
+
+            def loss_fn(p):
+                logits, mutated = model.apply(
+                    {"params": p, "batch_stats": batch_stats},
+                    imgs,
+                    train=True,
+                    mutable=["batch_stats"],
+                )
+                bce = sigmoid_bce(logits, msks)
+                prox = fedprox_penalty(p, anchor, mu_arr)
+                return bce + prox, (logits, mutated["batch_stats"])
+
+            (loss, (logits, new_stats)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            # Intra-client data parallelism: one SGD step over the full local
+            # batch, gradients and running BN stats averaged across the
+            # `batch` axis replicas.
+            grads = lax.pmean(grads, BATCH)
+            new_stats = lax.pmean(new_stats, BATCH)
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            inter, union = iou_counts(logits, msks)
+            metrics = {
+                "loss": lax.pmean(loss, BATCH),
+                "pixel_acc": lax.pmean(pixel_accuracy(logits, msks), BATCH),
+                "iou_inter": lax.psum(inter, BATCH),
+                "iou_union": lax.psum(union, BATCH),
+            }
+            return (new_params, new_stats, new_opt_state), metrics
+
+        def epoch_body(carry, _):
+            carry, step_metrics = lax.scan(sgd_step, carry, (images, masks))
+            epoch_metrics = {
+                "loss": jnp.mean(step_metrics["loss"]),
+                "pixel_acc": jnp.mean(step_metrics["pixel_acc"]),
+                "iou_inter": jnp.sum(step_metrics["iou_inter"]),
+                "iou_union": jnp.sum(step_metrics["iou_union"]),
+            }
+            return carry, epoch_metrics
+
+        # The carry becomes client-varying after the first data-dependent
+        # update; promote the (replicated) initial carry so scan's carry type
+        # is stable under shard_map's varying-axes tracking.
+        carry = jax.tree_util.tree_map(
+            lambda x: lax.pcast(x, (CLIENTS,), to="varying"),
+            (params, batch_stats, opt_state),
+        )
+        carry, per_epoch = lax.scan(
+            epoch_body, carry, None, length=max(1, local_epochs)
+        )
+        params, batch_stats, _ = carry
+
+        # Masked sample-weighted FedAvg over the clients axis (ICI psum).
+        w = active_i * n_i
+        denom = jnp.maximum(lax.psum(w, CLIENTS), 1e-9)
+        new_variables = {
+            "params": _masked_mean_over_clients(params, w, denom),
+            "batch_stats": _masked_mean_over_clients(batch_stats, w, denom),
+        }
+
+        last = jax.tree_util.tree_map(lambda a: a[-1], per_epoch)
+        metrics = {
+            "loss": last["loss"],
+            "pixel_acc": last["pixel_acc"],
+            "iou": iou_from_counts(last["iou_inter"], last["iou_union"]),
+            "active": active_i,
+        }
+        # [1]-shaped leaves tile back onto the clients axis.
+        metrics = jax.tree_util.tree_map(lambda a: a[None], metrics)
+        return new_variables, metrics
+
+    sharded = jax.shard_map(
+        client_fit,
+        mesh=mesh,
+        in_specs=(
+            P(),                            # variables: replicated
+            P(CLIENTS, None, BATCH),        # images [C, steps, B, H, W, 3]
+            P(CLIENTS, None, BATCH),        # masks  [C, steps, B, H, W, 1]
+            P(CLIENTS),                     # active [C]
+            P(CLIENTS),                     # n_samples [C]
+        ),
+        out_specs=(P(), P(CLIENTS)),
+    )
+
+    jitted = jax.jit(sharded)
+
+    def round_fn(variables, images, masks, active, n_samples):
+        if images.shape[0] != n_client_shards:
+            raise ValueError(
+                f"data carries {images.shape[0]} clients, mesh has "
+                f"{n_client_shards} on the '{CLIENTS}' axis"
+            )
+        active = np.asarray(active, np.float32)
+        n_samples = np.asarray(n_samples, np.float32)
+        # Same contract as fed.algorithms.fedavg: an empty effective cohort
+        # is an error, never a silently-zeroed global model.
+        if float(np.sum(active * n_samples)) <= 0.0:
+            raise ValueError(
+                "non-positive total FedAvg weight: every client dropped out "
+                f"(active={active.tolist()}, n_samples={n_samples.tolist()})"
+            )
+        return jitted(variables, images, masks, active, n_samples)
+
+    return round_fn
+
+
+@jax.jit
+def _weighted_mean(stacked: Any, w: jax.Array) -> Any:
+    def leaf(x):
+        acc = jnp.tensordot(w, x.astype(jnp.float32), axes=1)
+        return acc.astype(x.dtype)
+
+    return jax.tree_util.tree_map(leaf, stacked)
+
+
+def mesh_fedavg(
+    stacked: Any,
+    weights: Sequence[float] | jax.Array | None = None,
+    active: Sequence[float] | jax.Array | None = None,
+) -> Any:
+    """Masked weighted mean over the leading (client) axis of a stacked
+    pytree — the host-callable form of the in-mesh aggregation, used as the
+    golden cross-check against :func:`fedcrack_tpu.fed.algorithms.fedavg`
+    (SURVEY.md §4: "mesh FedAvg == gRPC FedAvg == numpy mean")."""
+    leaves = jax.tree_util.tree_leaves(stacked)
+    if not leaves:
+        raise ValueError("empty pytree")
+    k = leaves[0].shape[0]
+    w = (
+        jnp.ones((k,), jnp.float32)
+        if weights is None
+        else jnp.asarray(weights, jnp.float32)
+    )
+    if active is not None:
+        w = w * jnp.asarray(active, jnp.float32)
+    total = float(jnp.sum(w))
+    if total <= 0.0:
+        raise ValueError("non-positive total FedAvg weight (empty effective cohort)")
+    return _weighted_mean(stacked, w / total)
+
+
+def stack_client_data(
+    client_batches: Sequence[tuple[np.ndarray, np.ndarray]],
+    steps: int,
+    batch_size: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Pack per-client (images, masks) sample arrays into the round_fn layout
+    ``[C, steps, B, H, W, ch]``, truncating/cycling each client's samples to
+    exactly ``steps * batch_size`` (static shapes — SURVEY.md §7)."""
+    need = steps * batch_size
+    imgs_out, masks_out = [], []
+    for images, masks in client_batches:
+        n = images.shape[0]
+        if n == 0:
+            raise ValueError("client with zero samples")
+        idx = np.resize(np.arange(n), need)  # cycle if short, truncate if long
+        imgs_out.append(images[idx].reshape(steps, batch_size, *images.shape[1:]))
+        masks_out.append(masks[idx].reshape(steps, batch_size, *masks.shape[1:]))
+    return np.stack(imgs_out), np.stack(masks_out)

@@ -1,0 +1,45 @@
+"""Device-mesh construction for the federated data plane.
+
+Axes:
+
+- ``clients`` — one federated client per mesh row (the reference's
+  cross-process FedAvg cohort, fl_server.py:45-81, becomes a mesh axis).
+- ``batch``  — intra-client data parallelism over the local batch
+  (BASELINE.md config 5: "per-client pmap data-parallel").
+
+On a v5e-8 the default is ``(8, 1)`` — 8 clients, one chip each; the same
+code runs on a virtual CPU mesh in CI via
+``--xla_force_host_platform_device_count`` (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    n_clients: int,
+    n_batch: int = 1,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a ``Mesh`` with axes ``('clients', 'batch')``.
+
+    Uses the first ``n_clients * n_batch`` devices. Raises if the host does
+    not expose enough devices (the caller decides whether to shrink the
+    cohort or multiplex clients per chip).
+    """
+    if n_clients <= 0 or n_batch <= 0:
+        raise ValueError(f"mesh axes must be positive, got ({n_clients}, {n_batch})")
+    devs = list(devices) if devices is not None else jax.devices()
+    need = n_clients * n_batch
+    if len(devs) < need:
+        raise ValueError(
+            f"mesh ({n_clients} clients x {n_batch} batch) needs {need} devices, "
+            f"host exposes {len(devs)}"
+        )
+    grid = np.asarray(devs[:need], dtype=object).reshape(n_clients, n_batch)
+    return Mesh(grid, ("clients", "batch"))

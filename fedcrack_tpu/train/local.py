@@ -49,18 +49,25 @@ class TrainState(struct.PyTreeNode):
         )
 
 
+def make_optimizer(learning_rate: float = 1e-3) -> optax.GradientTransformation:
+    """Adam with Keras-default hyperparameters (the reference compiles with
+    optimizer="Adam", client_fit_model.py:157). Single source of truth for
+    BOTH execution planes — the host/gRPC path here and the one-program mesh
+    round in ``fedcrack_tpu.parallel`` must train identically."""
+    return optax.adam(learning_rate, b1=0.9, b2=0.999, eps=1e-7)
+
+
 def create_train_state(
     rng: jax.Array,
     model_config: ModelConfig | None = None,
     learning_rate: float = 1e-3,
 ) -> TrainState:
-    """Build the model once; Adam with Keras-default hyperparameters
-    (the reference compiles with optimizer="Adam", client_fit_model.py:157)."""
+    """Build the model once with the shared optimizer."""
     model_config = model_config or ModelConfig()
     model = ResUNet(config=model_config)
     dummy = jnp.zeros((1, *model_config.input_shape), jnp.float32)
     variables = model.init(rng, dummy, train=False)
-    tx = optax.adam(learning_rate, b1=0.9, b2=0.999, eps=1e-7)
+    tx = make_optimizer(learning_rate)
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=variables["params"],
