@@ -1,0 +1,32 @@
+"""Checkpoint / resume (orbax-backed).
+
+The reference writes weight pickles to disk but can never restore mid-run
+state — a restarted server forgets all rounds (reference:
+fl_server.py:104-105 writes ``./server_weights/weights.pickle`` that nothing
+reads; SURVEY.md §5.4). Here both planes checkpoint durably:
+
+- the federation coordinator saves ``(round, model_version, global variables,
+  history)`` after every aggregation and can resume a federation where it
+  left off (a fresh enrollment window opens, then rounds continue from the
+  restored round counter);
+- the centralized trainer keeps best-val and latest states (the reference's
+  ``ModelCheckpoint(save_best_only=True)``, test/Segmentation.py:177-179).
+
+Orbax is the TPU-native choice: zarr-sharded array storage, async-safe,
+restores straight onto whatever device/sharding layout the restore-side
+template carries.
+"""
+
+from fedcrack_tpu.ckpt.manager import (
+    FedCheckpoint,
+    FedCheckpointer,
+    restore_server_state,
+    save_server_state,
+)
+
+__all__ = [
+    "FedCheckpoint",
+    "FedCheckpointer",
+    "restore_server_state",
+    "save_server_state",
+]

@@ -1,0 +1,157 @@
+"""Orbax checkpoint manager for federation + trainer state.
+
+Layout under ``directory/``: one orbax step per ``model_version``, each a
+composite of the variables pytree (zarr-sharded arrays) and a JSON metadata
+blob (round, version, phase-independent history). ``max_to_keep`` bounds
+disk usage; the latest step wins on restore.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import os
+from typing import Any, Mapping
+
+import orbax.checkpoint as ocp
+
+
+@dataclasses.dataclass(frozen=True)
+class FedCheckpoint:
+    """What a coordinator needs to resume a federation."""
+
+    current_round: int
+    model_version: int
+    variables: Any
+    history: tuple[dict, ...] = ()
+    # Client-uploaded log chunks (rounds.py LogChunk sink): title -> bytes.
+    logs: Mapping[str, bytes] = dataclasses.field(default_factory=dict)
+
+
+class FedCheckpointer:
+    """Thin wrapper over ``orbax.checkpoint.CheckpointManager``.
+
+    Steps are keyed by ``model_version`` — strictly monotonic across a
+    federation (bumped exactly once per aggregation, fed/rounds.py), so
+    "latest step" is always "most recent round".
+    """
+
+    def __init__(self, directory: str | os.PathLike, max_to_keep: int = 3):
+        self._dir = os.path.abspath(os.fspath(directory))
+        os.makedirs(self._dir, exist_ok=True)
+        self._mngr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, enable_async_checkpointing=False
+            ),
+        )
+
+    def save(self, ckpt: FedCheckpoint) -> None:
+        meta = {
+            "current_round": ckpt.current_round,
+            "model_version": ckpt.model_version,
+            "history": list(ckpt.history),
+            "logs": {
+                k: base64.b64encode(v).decode("ascii") for k, v in ckpt.logs.items()
+            },
+        }
+        self._mngr.save(
+            ckpt.model_version,
+            args=ocp.args.Composite(
+                variables=ocp.args.StandardSave(ckpt.variables),
+                meta=ocp.args.JsonSave(meta),
+            ),
+        )
+        self._mngr.wait_until_finished()
+
+    def latest_version(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def restore(self, template: Any | None = None) -> FedCheckpoint | None:
+        """Restore the latest checkpoint; ``template`` (a matching variables
+        pytree, e.g. a freshly initialized model) pins dtypes/shardings —
+        without it arrays come back as host numpy."""
+        step = self._mngr.latest_step()
+        if step is None:
+            return None
+        restore_args = (
+            ocp.args.StandardRestore(template)
+            if template is not None
+            else ocp.args.StandardRestore()
+        )
+        restored = self._mngr.restore(
+            step,
+            args=ocp.args.Composite(
+                variables=restore_args, meta=ocp.args.JsonRestore()
+            ),
+        )
+        meta = restored["meta"]
+        return FedCheckpoint(
+            current_round=int(meta["current_round"]),
+            model_version=int(meta["model_version"]),
+            variables=restored["variables"],
+            history=tuple(meta.get("history", [])),
+            logs={
+                k: base64.b64decode(v) for k, v in meta.get("logs", {}).items()
+            },
+        )
+
+    def close(self) -> None:
+        self._mngr.close()
+
+    def __enter__(self) -> "FedCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ---- coordinator state bridge (fed/rounds.py ServerState <-> checkpoint) ----
+
+
+def save_server_state(ckptr: FedCheckpointer, state: Any) -> None:
+    """Persist a ``fed.rounds.ServerState`` after an aggregation."""
+    from fedcrack_tpu.fed.serialization import tree_from_bytes
+
+    ckptr.save(
+        FedCheckpoint(
+            current_round=state.current_round,
+            model_version=state.model_version,
+            variables=tree_from_bytes(state.global_blob),
+            history=state.history,
+            logs=state.logs,
+        )
+    )
+
+
+def restore_server_state(
+    ckptr: FedCheckpointer, config: Any, template: Any | None = None
+) -> Any | None:
+    """Rebuild a resumable ``ServerState`` from the latest checkpoint.
+
+    The restored coordinator re-opens enrollment (a fresh cohort must
+    register — the old one's streams died with the old process) but keeps
+    the round counter, model version, averaged weights, and history, so the
+    federation continues instead of restarting from round 1 (closing
+    SURVEY.md §5.4: "a restarted server forgets rounds").
+    Returns ``None`` when the directory holds no checkpoint.
+    """
+    from fedcrack_tpu.fed import rounds as R
+    from fedcrack_tpu.fed.serialization import tree_to_bytes
+
+    ckpt = ckptr.restore(template)
+    if ckpt is None:
+        return None
+    if ckpt.current_round > config.max_rounds:
+        phase = R.PHASE_FINISHED
+    else:
+        phase = R.PHASE_ENROLL
+    return R.ServerState(
+        config=config,
+        global_blob=tree_to_bytes(ckpt.variables),
+        phase=phase,
+        current_round=ckpt.current_round,
+        model_version=ckpt.model_version,
+        history=ckpt.history,
+        logs=ckpt.logs,
+    )

@@ -91,6 +91,12 @@ class FedConfig:
     model_type: str = "resunet"
     host: str = "127.0.0.1"
     port: int = 8889              # reference: fl_server.py:218
+    # Orbax checkpoint directory; empty disables. When the directory already
+    # holds a checkpoint the federation resumes from the latest round
+    # (SURVEY.md §5.4 — the reference server forgot rounds on restart).
+    ckpt_dir: str = ""
+    # PRNG seed for the initial global model.
+    seed: int = 0
     max_message_mb: int = 512     # reference: fl_server.py:215 (both directions here)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)

@@ -30,7 +30,13 @@ def build_config(argv: list[str] | None = None) -> FedConfig:
     p.add_argument("--registration-window", type=float, dest="registration_window_s")
     p.add_argument("--round-deadline", type=float, dest="round_deadline_s")
     p.add_argument("--fedprox-mu", type=float, dest="fedprox_mu")
-    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--seed", type=int, help="PRNG seed for the initial global model")
+    p.add_argument(
+        "--ckpt-dir",
+        dest="ckpt_dir",
+        help="orbax checkpoint directory; when it already holds a checkpoint "
+        "the federation resumes from the latest round (SURVEY.md §5.4)",
+    )
     args = p.parse_args(argv)
 
     if args.config:
@@ -47,6 +53,8 @@ def build_config(argv: list[str] | None = None) -> FedConfig:
         ("registration_window_s", "registration_window_s"),
         ("round_deadline_s", "round_deadline_s"),
         ("fedprox_mu", "fedprox_mu"),
+        ("ckpt_dir", "ckpt_dir"),
+        ("seed", "seed"),
     ]:
         val = getattr(args, flag)
         if val is not None:
@@ -55,9 +63,7 @@ def build_config(argv: list[str] | None = None) -> FedConfig:
         import dataclasses
 
         cfg = dataclasses.replace(cfg, **overrides)
-    cfg_dict = json.loads(cfg.to_json())
-    cfg_dict["_seed"] = args.seed
-    logging.info("config: %s", cfg_dict)
+    logging.info("config: %s", json.loads(cfg.to_json()))
     return cfg
 
 
@@ -68,8 +74,13 @@ def main(argv: list[str] | None = None) -> int:
     cfg = build_config(argv)
     # Build + serialize the initial global model (the reference delegates
     # this to the missing model_evaluate module, SURVEY.md §2.5).
-    state = create_train_state(jax.random.key(0), cfg.model, cfg.learning_rate)
-    server = FedServer(cfg, state.variables)
+    state = create_train_state(jax.random.key(cfg.seed), cfg.model, cfg.learning_rate)
+    checkpointer = None
+    if cfg.ckpt_dir:
+        from fedcrack_tpu.ckpt import FedCheckpointer
+
+        checkpointer = FedCheckpointer(cfg.ckpt_dir)
+    server = FedServer(cfg, state.variables, checkpointer=checkpointer)
     final = asyncio.run(server.serve_until_finished())
     logging.info(
         "federation finished: %d rounds, final cohort %s",

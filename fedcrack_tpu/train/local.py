@@ -24,6 +24,7 @@ import optax
 from flax import core, struct
 
 from fedcrack_tpu.configs import FedConfig, ModelConfig
+from fedcrack_tpu.fed.algorithms import fedprox_penalty
 from fedcrack_tpu.models import ResUNet
 from fedcrack_tpu.ops.losses import iou_from_counts, segmentation_metrics, sigmoid_bce
 
@@ -78,15 +79,6 @@ def create_train_state(
     )
 
 
-def _l2_sq(tree_a, tree_b) -> jax.Array:
-    leaves = jax.tree_util.tree_map(
-        lambda a, b: jnp.sum((a.astype(jnp.float32) - b.astype(jnp.float32)) ** 2),
-        tree_a,
-        tree_b,
-    )
-    return jax.tree_util.tree_reduce(jnp.add, leaves, jnp.zeros((), jnp.float32))
-
-
 # NB: no buffer donation — `anchor_params` aliases `state.params` in the
 # plain-FedAvg call, and donating aliased inputs is undefined.
 @jax.jit
@@ -111,7 +103,7 @@ def train_step(
             mutable=["batch_stats"],
         )
         bce = sigmoid_bce(logits, masks)
-        prox = 0.5 * mu * _l2_sq(params, anchor_params)
+        prox = fedprox_penalty(params, anchor_params, mu)
         return bce + prox, (logits, mutated["batch_stats"])
 
     (loss, (logits, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(

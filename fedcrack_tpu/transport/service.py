@@ -52,12 +52,32 @@ class FedServer:
         global_variables: Any,
         clock: Callable[[], float] = time.monotonic,
         tick_period_s: float = 1.0,
+        checkpointer: Any | None = None,
     ):
         self.config = config
         self.state = R.initial_state(config, global_variables)
+        self._checkpointer = checkpointer
+        if checkpointer is not None:
+            # Resume from the latest checkpoint when one exists: keep the
+            # round counter / version / averaged weights, re-open enrollment
+            # (SURVEY.md §5.4 — the reference server forgot rounds on restart).
+            from fedcrack_tpu.ckpt import restore_server_state
+
+            resumed = restore_server_state(checkpointer, config, global_variables)
+            if resumed is not None:
+                log.info(
+                    "resuming from checkpoint: round %d, model_version %d",
+                    resumed.current_round,
+                    resumed.model_version,
+                )
+                self.state = resumed
         self._clock = clock
         self._tick_period_s = tick_period_s
         self._lock = asyncio.Lock()
+        # Serializes checkpoint writes: orbax CheckpointManager is not
+        # thread-safe and saves must land in version order.
+        self._ckpt_lock = asyncio.Lock()
+        self._ckpt_tasks: set[asyncio.Task] = set()
         self._server: grpc.aio.Server | None = None
         self._tick_task: asyncio.Task | None = None
         self.bound_port: int | None = None
@@ -67,10 +87,35 @@ class FedServer:
 
     async def _apply(self, event: R.Event) -> R.Reply:
         async with self._lock:
+            prev_version = self.state.model_version
             self.state, reply = R.transition(self.state, event)
             if self.state.phase == R.PHASE_FINISHED:
                 self.finished.set()
-            return reply
+            state = self.state
+        if self._checkpointer is not None and state.model_version != prev_version:
+            # Aggregation happened: persist as a background task so the
+            # barrier-completing client's RESP_ARY reply (and the tick loop)
+            # never stalls on disk I/O. The checkpoint lock keeps saves
+            # single-flight and in version order (tasks start in creation
+            # order and asyncio.Lock wakes waiters FIFO). Durability is
+            # best-effort relative to protocol liveness: a failed save must
+            # not swallow the reply.
+            task = asyncio.create_task(self._save_checkpoint(state))
+            self._ckpt_tasks.add(task)
+            task.add_done_callback(self._ckpt_tasks.discard)
+        return reply
+
+    async def _save_checkpoint(self, state: R.ServerState) -> None:
+        from fedcrack_tpu.ckpt import save_server_state
+
+        async with self._ckpt_lock:
+            try:
+                await asyncio.to_thread(save_server_state, self._checkpointer, state)
+            except Exception:
+                log.exception(
+                    "checkpoint save failed for model_version %d",
+                    state.model_version,
+                )
 
     async def _tick_forever(self) -> None:
         """Drives pure time effects: enrollment-window close and round
@@ -121,6 +166,9 @@ class FedServer:
     async def stop(self, grace: float = 1.0) -> None:
         if self._tick_task is not None:
             self._tick_task.cancel()
+        # Drain in-flight checkpoint saves before shutdown.
+        if self._ckpt_tasks:
+            await asyncio.gather(*tuple(self._ckpt_tasks), return_exceptions=True)
         if self._server is not None:
             await self._server.stop(grace)
 
