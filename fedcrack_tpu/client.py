@@ -34,6 +34,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--synthetic", type=int, default=0, help="use N generated samples")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--predict-dir", help="write final-round mask predictions here")
+    p.add_argument("--metrics", dest="metrics_path", help="JSONL metrics file")
+    p.add_argument(
+        "--profile-dir",
+        dest="profile_dir",
+        help="jax.profiler trace dir wrapping each round's local fit",
+    )
     args = p.parse_args(argv)
 
     if args.config:
@@ -41,17 +47,20 @@ def main(argv: list[str] | None = None) -> int:
             cfg = FedConfig.from_json(f.read())
     else:
         cfg = FedConfig()
-    if args.host or args.port:
+    overrides = {
+        k: v
+        for k, v in [
+            ("host", args.host),
+            ("port", args.port),
+            ("metrics_path", args.metrics_path),
+            ("profile_dir", args.profile_dir),
+        ]
+        if v is not None
+    }
+    if overrides:
         import dataclasses
 
-        cfg = dataclasses.replace(
-            cfg,
-            **{
-                k: v
-                for k, v in [("host", args.host), ("port", args.port)]
-                if v is not None
-            },
-        )
+        cfg = dataclasses.replace(cfg, **overrides)
 
     batch = cfg.data.batch_size
     if args.synthetic:
@@ -75,9 +84,23 @@ def main(argv: list[str] | None = None) -> int:
     else:
         p.error("need --image-dir/--mask-dir or --synthetic N")
 
-    train_fn, holder = make_train_fn(cfg, dataset, batch, seed=args.seed)
+    metrics_logger = None
+    if cfg.metrics_path:
+        from fedcrack_tpu.obs import MetricsLogger
+
+        metrics_logger = MetricsLogger(cfg.metrics_path)
+    train_fn, holder = make_train_fn(
+        cfg, dataset, batch, seed=args.seed, metrics_logger=metrics_logger
+    )
     client = FedClient(cfg, train_fn, cname=args.name)
     result = client.run_session()
+    if metrics_logger is not None:
+        metrics_logger.log(
+            "session",
+            enrolled=result.enrolled,
+            rounds_completed=result.rounds_completed,
+        )
+        metrics_logger.close()
     logging.info(
         "session done: enrolled=%s rounds=%d", result.enrolled, result.rounds_completed
     )

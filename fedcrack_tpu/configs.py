@@ -97,6 +97,11 @@ class FedConfig:
     ckpt_dir: str = ""
     # PRNG seed for the initial global model.
     seed: int = 0
+    # JSONL structured-metrics file (per-round records, SURVEY.md §5.5);
+    # empty disables.
+    metrics_path: str = ""
+    # jax.profiler trace directory for training spans; empty disables.
+    profile_dir: str = ""
     max_message_mb: int = 512     # reference: fl_server.py:215 (both directions here)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)

@@ -200,6 +200,7 @@ def _aggregate(state: ServerState, now: float) -> ServerState:
     counts = [state.received[n][1] for n in names]
     weights = counts if any(c > 0 for c in counts) else None
     avg = fedavg(trees, weights)
+    new_blob = tree_to_bytes(avg)
     new_round = state.current_round + 1
     finished = new_round > state.config.max_rounds
     entry = {
@@ -207,9 +208,16 @@ def _aggregate(state: ServerState, now: float) -> ServerState:
         "clients": names,
         "samples": counts,
         "completed_at": now,
+        # Observability (SURVEY.md §5.5): round wall-clock + control-plane
+        # bytes (client uploads in, one broadcast-sized blob out per client).
+        "wall_clock_s": (
+            now - state.round_started_at if state.round_started_at is not None else None
+        ),
+        "bytes_received": sum(len(state.received[n][0]) for n in names),
+        "bytes_broadcast": len(new_blob),
     }
     return state._replace(
-        global_blob=tree_to_bytes(avg),
+        global_blob=new_blob,
         current_round=new_round,
         model_version=state.model_version + 1,
         received={},

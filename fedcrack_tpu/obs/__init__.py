@@ -1,0 +1,18 @@
+"""Observability: structured metrics, wall-clock timing, profiler hooks.
+
+The reference's observability is ``print`` banners (fl_server.py:111,119,126)
+plus a per-round TensorBoard callback whose upload path is commented out
+(client_fit_model.py:153-154, fl_client.py:110-118; SURVEY.md §5.1/§5.5).
+Here both planes emit structured JSONL records — per-round loss/IoU,
+wall-clock, and bytes moved on the control plane — and ``jax.profiler``
+traces can wrap any training span for TPU timeline inspection.
+"""
+
+from fedcrack_tpu.obs.metrics import (
+    MetricsLogger,
+    profiler_trace,
+    read_metrics,
+    stopwatch,
+)
+
+__all__ = ["MetricsLogger", "profiler_trace", "read_metrics", "stopwatch"]

@@ -1,0 +1,128 @@
+"""JSONL metrics sink + timing/profiling helpers.
+
+One record per line, one file per process; records carry a monotonic
+``t`` (seconds since logger creation) and a wall-clock ``ts`` so runs can
+be merged across machines. The sink is thread-safe: the gRPC service, the
+tick loop, and checkpoint tasks may all log concurrently.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import numbers
+import os
+import threading
+import time
+from typing import Any, Iterator
+
+
+class MetricsLogger:
+    """Append-only JSONL metrics writer.
+
+    ``kind`` names the record type (``round``, ``fit_epoch``, ``session``,
+    ...); everything else is free-form JSON-safe fields. Non-JSON values
+    (jax/numpy scalars) are coerced via ``float``/``int`` where possible.
+    """
+
+    def __init__(self, path: str | os.PathLike | io.TextIOBase, echo=None):
+        if isinstance(path, io.TextIOBase):
+            self._f = path
+            self._owns = False
+        else:
+            p = os.fspath(path)
+            parent = os.path.dirname(os.path.abspath(p))
+            os.makedirs(parent, exist_ok=True)
+            self._f = open(p, "a", encoding="utf-8")
+            self._owns = True
+        self._echo = echo
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+
+    def log(self, kind: str, **fields: Any) -> dict:
+        record = {
+            "kind": kind,
+            "t": round(time.monotonic() - self._t0, 6),
+            "ts": time.time(),
+        }
+        for k, v in fields.items():
+            record[k] = _coerce(v)
+        line = json.dumps(record, sort_keys=True)
+        with self._lock:
+            self._f.write(line + "\n")
+            self._f.flush()
+        if self._echo is not None:
+            self._echo(line)
+        return record
+
+    def close(self) -> None:
+        if self._owns:
+            with self._lock:
+                self._f.close()
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _coerce(value: Any) -> Any:
+    """Make jax/numpy scalars and containers JSON-safe."""
+    if isinstance(value, (str, bool, int, float)) or value is None:
+        return value
+    if isinstance(value, dict):
+        return {str(k): _coerce(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_coerce(v) for v in value]
+    if isinstance(value, numbers.Integral):
+        return int(value)
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return repr(value)
+
+
+def read_metrics(path: str | os.PathLike, kind: str | None = None) -> list[dict]:
+    """Load a JSONL metrics file, optionally filtered by record kind."""
+    records = []
+    with open(os.fspath(path), encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if kind is None or rec.get("kind") == kind:
+                records.append(rec)
+    return records
+
+
+@contextlib.contextmanager
+def stopwatch() -> Iterator[dict]:
+    """``with stopwatch() as w: ...; w['seconds']`` — wall-clock of a span."""
+    out = {"seconds": 0.0}
+    t0 = time.perf_counter()
+    try:
+        yield out
+    finally:
+        out["seconds"] = time.perf_counter() - t0
+
+
+@contextlib.contextmanager
+def profiler_trace(logdir: str | None) -> Iterator[None]:
+    """Wrap a span in ``jax.profiler.trace`` when ``logdir`` is set.
+
+    The produced trace is the TPU-native upgrade of the reference's
+    TensorBoard callback (client_fit_model.py:153-154): open it with
+    TensorBoard's profile plugin or xprof to see the XLA op timeline.
+    ``None`` disables tracing with zero overhead.
+    """
+    if not logdir:
+        yield
+        return
+    import jax
+
+    os.makedirs(logdir, exist_ok=True)
+    with jax.profiler.trace(logdir):
+        yield

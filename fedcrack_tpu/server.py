@@ -37,6 +37,11 @@ def build_config(argv: list[str] | None = None) -> FedConfig:
         help="orbax checkpoint directory; when it already holds a checkpoint "
         "the federation resumes from the latest round (SURVEY.md §5.4)",
     )
+    p.add_argument(
+        "--metrics",
+        dest="metrics_path",
+        help="JSONL file for structured per-round metrics (SURVEY.md §5.5)",
+    )
     args = p.parse_args(argv)
 
     if args.config:
@@ -55,6 +60,7 @@ def build_config(argv: list[str] | None = None) -> FedConfig:
         ("fedprox_mu", "fedprox_mu"),
         ("ckpt_dir", "ckpt_dir"),
         ("seed", "seed"),
+        ("metrics_path", "metrics_path"),
     ]:
         val = getattr(args, flag)
         if val is not None:
@@ -80,8 +86,15 @@ def main(argv: list[str] | None = None) -> int:
         from fedcrack_tpu.ckpt import FedCheckpointer
 
         checkpointer = FedCheckpointer(cfg.ckpt_dir)
-    server = FedServer(cfg, state.variables, checkpointer=checkpointer)
+    metrics = None
+    if cfg.metrics_path:
+        from fedcrack_tpu.obs import MetricsLogger
+
+        metrics = MetricsLogger(cfg.metrics_path)
+    server = FedServer(cfg, state.variables, checkpointer=checkpointer, metrics=metrics)
     final = asyncio.run(server.serve_until_finished())
+    if metrics is not None:
+        metrics.close()
     logging.info(
         "federation finished: %d rounds, final cohort %s",
         len(final.history),

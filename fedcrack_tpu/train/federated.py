@@ -30,10 +30,18 @@ def make_train_fn(
     dataset: Iterable,
     batch_size: int,
     seed: int = 0,
+    metrics_logger=None,
 ):
     """Returns ``train_fn(blob, round) -> (blob, sample_count, metrics)`` plus
     a handle to read the latest :class:`TrainState` (for final-round
-    prediction)."""
+    prediction).
+
+    When ``config.profile_dir`` is set each round's local fit is wrapped in a
+    ``jax.profiler`` trace; ``metrics_logger`` (an ``obs.MetricsLogger``)
+    receives one structured ``local_fit`` record per round.
+    """
+    from fedcrack_tpu.obs import profiler_trace, stopwatch
+
     state = create_train_state(
         jax.random.key(seed), config.model, config.learning_rate
     )
@@ -44,15 +52,27 @@ def make_train_fn(
         variables = tree_from_bytes(blob, template=template)
         st = holder["state"].replace_variables(variables)
         st = reset_optimizer(st)
-        st, metrics = local_fit(
-            st,
-            dataset,
-            epochs=config.local_epochs,
-            mu=config.fedprox_mu,
-            anchor_params=st.params,
-        )
+        with profiler_trace(config.profile_dir or None), stopwatch() as timer:
+            st, metrics = local_fit(
+                st,
+                dataset,
+                epochs=config.local_epochs,
+                mu=config.fedprox_mu,
+                anchor_params=st.params,
+            )
         holder["state"] = st
         n_samples = int(metrics.pop("num_steps", 0) * batch_size)
-        return tree_to_bytes(st.variables), n_samples, metrics
+        out_blob = tree_to_bytes(st.variables)
+        if metrics_logger is not None:
+            metrics_logger.log(
+                "local_fit",
+                round=rnd,
+                wall_clock_s=timer["seconds"],
+                num_samples=n_samples,
+                bytes_in=len(blob),
+                bytes_out=len(out_blob),
+                **metrics,
+            )
+        return out_blob, n_samples, metrics
 
     return train_fn, holder

@@ -53,6 +53,7 @@ class FedServer:
         clock: Callable[[], float] = time.monotonic,
         tick_period_s: float = 1.0,
         checkpointer: Any | None = None,
+        metrics: Any | None = None,
     ):
         self.config = config
         self.state = R.initial_state(config, global_variables)
@@ -71,6 +72,7 @@ class FedServer:
                     resumed.model_version,
                 )
                 self.state = resumed
+        self._metrics = metrics
         self._clock = clock
         self._tick_period_s = tick_period_s
         self._lock = asyncio.Lock()
@@ -92,6 +94,10 @@ class FedServer:
             if self.state.phase == R.PHASE_FINISHED:
                 self.finished.set()
             state = self.state
+        if self._metrics is not None and state.model_version != prev_version:
+            # One structured record per completed round (SURVEY.md §5.5 —
+            # the reference printed banners instead).
+            self._metrics.log("round", **state.history[-1])
         if self._checkpointer is not None and state.model_version != prev_version:
             # Aggregation happened: persist as a background task so the
             # barrier-completing client's RESP_ARY reply (and the tick loop)

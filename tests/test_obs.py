@@ -1,0 +1,118 @@
+"""Observability: JSONL metrics sink, round records, profiler hook.
+
+The reference's observability is print banners + a disabled TensorBoard
+upload path (SURVEY.md §5.1/§5.5); these tests pin the structured
+replacement.
+"""
+
+import dataclasses
+import json
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from fedcrack_tpu.configs import FedConfig, ModelConfig
+from fedcrack_tpu.fed import rounds as R
+from fedcrack_tpu.fed.serialization import tree_to_bytes
+from fedcrack_tpu.obs import MetricsLogger, profiler_trace, read_metrics, stopwatch
+
+TINY = ModelConfig(
+    img_size=16, stem_features=4, encoder_features=(8,), decoder_features=(8, 4)
+)
+
+
+def test_metrics_logger_round_trip(tmp_path):
+    path = tmp_path / "m.jsonl"
+    with MetricsLogger(path) as m:
+        m.log("round", round=1, loss=0.5, clients=["a", "b"])
+        m.log("fit", loss=jnp.float32(0.25), n=np.int64(3))
+    records = read_metrics(path)
+    assert [r["kind"] for r in records] == ["round", "fit"]
+    assert records[0]["clients"] == ["a", "b"]
+    # jax/numpy scalars come back as plain JSON numbers, integers as ints
+    assert records[1]["loss"] == 0.25
+    assert records[1]["n"] == 3
+    assert isinstance(records[1]["n"], int)
+    assert all("t" in r and "ts" in r for r in records)
+
+
+def test_metrics_logger_kind_filter_and_append(tmp_path):
+    path = tmp_path / "m.jsonl"
+    with MetricsLogger(path) as m:
+        m.log("a", x=1)
+    with MetricsLogger(path) as m:  # append, not truncate
+        m.log("b", x=2)
+    assert len(read_metrics(path)) == 2
+    assert [r["x"] for r in read_metrics(path, kind="b")] == [2]
+
+
+def test_metrics_logger_thread_safety(tmp_path):
+    path = tmp_path / "m.jsonl"
+    with MetricsLogger(path) as m:
+        threads = [
+            threading.Thread(target=lambda i=i: [m.log("t", i=i) for _ in range(50)])
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    records = read_metrics(path)
+    assert len(records) == 200
+    # every line parsed cleanly (no interleaved writes)
+    for rec in records:
+        assert rec["kind"] == "t"
+
+
+def test_stopwatch_measures_time():
+    with stopwatch() as w:
+        pass
+    assert 0.0 <= w["seconds"] < 1.0
+
+
+def test_profiler_trace_disabled_is_noop():
+    with profiler_trace(None):
+        x = jnp.ones((4,)) + 1
+    assert float(x.sum()) == 8.0
+
+
+def test_profiler_trace_writes_events(tmp_path):
+    logdir = tmp_path / "trace"
+    with profiler_trace(str(logdir)):
+        jnp.ones((8, 8)).sum().block_until_ready()
+    produced = list(logdir.rglob("*"))
+    assert produced, "profiler trace produced no files"
+
+
+def test_round_history_carries_wall_clock_and_bytes():
+    """The state machine's history entries now carry the observability
+    fields (wall_clock_s, bytes_received, bytes_broadcast)."""
+    from fedcrack_tpu.train.local import create_train_state
+
+    import jax
+
+    cfg = FedConfig(
+        max_rounds=1,
+        cohort_size=2,
+        registration_window_s=100.0,
+        model=TINY,
+        data=dataclasses.replace(FedConfig().data, img_size=16),
+    )
+    variables = create_train_state(jax.random.key(0), TINY).variables
+    blob = tree_to_bytes(variables)
+    state = R.initial_state(cfg, variables)
+    state, _ = R.transition(state, R.Ready(cname="a", now=0.0))
+    state, _ = R.transition(state, R.Ready(cname="b", now=1.0))
+    state, _ = R.transition(
+        state, R.TrainDone(cname="a", round=1, blob=blob, num_samples=4, now=3.0)
+    )
+    state, _ = R.transition(
+        state, R.TrainDone(cname="b", round=1, blob=blob, num_samples=4, now=5.0)
+    )
+    entry = state.history[0]
+    assert entry["wall_clock_s"] == 4.0  # round opened at now=1.0 (cohort full)
+    assert entry["bytes_received"] == 2 * len(blob)
+    assert entry["bytes_broadcast"] > 0
+    # history entries are JSON-serializable (checkpoint meta requirement)
+    json.dumps(entry)
