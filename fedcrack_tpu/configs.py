@@ -102,6 +102,9 @@ class FedConfig:
     metrics_path: str = ""
     # jax.profiler trace directory for training spans; empty disables.
     profile_dir: str = ""
+    # Msgpack pytree seeding the initial global model (e.g. from the Keras h5
+    # importer, tools/h5_import.py); empty initializes from `seed`.
+    init_weights: str = ""
     max_message_mb: int = 512     # reference: fl_server.py:215 (both directions here)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)

@@ -11,6 +11,7 @@ from __future__ import annotations
 import contextlib
 import io
 import json
+import math
 import numbers
 import os
 import threading
@@ -79,9 +80,12 @@ def _coerce(value: Any) -> Any:
     if isinstance(value, numbers.Integral):
         return int(value)
     try:
-        return float(value)
+        as_float = float(value)
     except (TypeError, ValueError):
         return repr(value)
+    # NaN/Infinity are not valid JSON (RFC 8259); keep the line parseable by
+    # strict consumers (jq, JSON.parse) while preserving the diagnostic.
+    return as_float if math.isfinite(as_float) else str(as_float)
 
 
 def read_metrics(path: str | os.PathLike, kind: str | None = None) -> list[dict]:

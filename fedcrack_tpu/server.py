@@ -42,6 +42,12 @@ def build_config(argv: list[str] | None = None) -> FedConfig:
         dest="metrics_path",
         help="JSONL file for structured per-round metrics (SURVEY.md §5.5)",
     )
+    p.add_argument(
+        "--init-weights",
+        dest="init_weights",
+        help="seed the global model from a msgpack pytree (e.g. produced by "
+        "`python -m fedcrack_tpu.tools.h5_import crack_segmentation.h5 out.msgpack`)",
+    )
     args = p.parse_args(argv)
 
     if args.config:
@@ -61,6 +67,7 @@ def build_config(argv: list[str] | None = None) -> FedConfig:
         ("ckpt_dir", "ckpt_dir"),
         ("seed", "seed"),
         ("metrics_path", "metrics_path"),
+        ("init_weights", "init_weights"),
     ]:
         val = getattr(args, flag)
         if val is not None:
@@ -81,6 +88,13 @@ def main(argv: list[str] | None = None) -> int:
     # Build + serialize the initial global model (the reference delegates
     # this to the missing model_evaluate module, SURVEY.md §2.5).
     state = create_train_state(jax.random.key(cfg.seed), cfg.model, cfg.learning_rate)
+    variables = state.variables
+    if cfg.init_weights:
+        from fedcrack_tpu.fed.serialization import tree_from_bytes
+
+        with open(cfg.init_weights, "rb") as f:
+            variables = tree_from_bytes(f.read(), template=variables)
+        logging.info("seeded global model from %s", cfg.init_weights)
     checkpointer = None
     if cfg.ckpt_dir:
         from fedcrack_tpu.ckpt import FedCheckpointer
@@ -91,7 +105,7 @@ def main(argv: list[str] | None = None) -> int:
         from fedcrack_tpu.obs import MetricsLogger
 
         metrics = MetricsLogger(cfg.metrics_path)
-    server = FedServer(cfg, state.variables, checkpointer=checkpointer, metrics=metrics)
+    server = FedServer(cfg, variables, checkpointer=checkpointer, metrics=metrics)
     final = asyncio.run(server.serve_until_finished())
     if metrics is not None:
         metrics.close()

@@ -1,0 +1,287 @@
+"""Keras h5 -> Flax ResUNet weight importer.
+
+The reference's centralized trainer checkpoints its best U-Net to
+``crack_segmentation.h5`` (reference: test/Segmentation.py:177-179) and the
+federation bootstraps from Keras weights; that blob is absent from the
+snapshot (SURVEY.md §0.1) but its architecture is fully specified
+(SURVEY.md §2.3). This importer lets a real Keras checkpoint seed our Flax
+global model tensor-for-tensor (SURVEY.md §7 step 8).
+
+Supported files: weights-only h5 (``model.save_weights``) and full-model h5
+(``model.save`` / ``ModelCheckpoint``, weights under ``model_weights``).
+
+Kernel-layout conversions (verified empirically against Keras forward
+passes, see tests/test_h5_import.py):
+
+- ``Conv2D``: kernel ``(kh, kw, in, out)`` — identical in Flax; no transform.
+- ``SeparableConv2D``: Keras depthwise kernel ``(kh, kw, in, 1)`` ->
+  Flax grouped-conv kernel ``(kh, kw, 1, in)`` (transpose last two axes);
+  pointwise ``(1, 1, in, out)`` unchanged; bias on the pointwise stage.
+- ``Conv2DTranspose``: Keras kernel ``(kh, kw, out, in)`` is the
+  gradient-of-conv orientation; Flax ``nn.ConvTranspose`` wants
+  ``(kh, kw, in, out)`` un-flipped — so flip both spatial axes and swap the
+  channel axes.
+- ``BatchNormalization``: gamma/beta -> params ``scale``/``bias``;
+  moving mean/variance -> ``batch_stats`` ``mean``/``var``.
+
+Layer matching is by layer *type* (read from the h5 weight names), in model
+order within each type, with every tensor shape validated against the target
+Flax parameter — a mismatch raises instead of silently mis-seeding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+from fedcrack_tpu.configs import ModelConfig
+
+try:  # h5py ships with the image; gate anyway so import of tools/ never fails
+    import h5py
+
+    HAVE_H5PY = True
+except ImportError:  # pragma: no cover
+    HAVE_H5PY = False
+
+
+@dataclasses.dataclass(frozen=True)
+class _Layer:
+    name: str
+    kind: str  # conv | separable | convT | bn
+    weights: dict[str, np.ndarray]  # canonical name -> array
+
+
+_CANONICAL = (
+    "depthwise_kernel",
+    "pointwise_kernel",
+    "moving_mean",
+    "moving_variance",
+    "kernel",
+    "bias",
+    "gamma",
+    "beta",
+)
+
+
+def _canon(weight_name: str) -> str:
+    base = weight_name.split("/")[-1].split(":")[0]
+    for cand in _CANONICAL:  # longest-match first (kernel vs *_kernel)
+        if base == cand or base.endswith(cand):
+            return cand
+    raise ValueError(f"unrecognized weight name {weight_name!r}")
+
+
+def _classify(layer_name: str, weights: dict[str, np.ndarray]) -> str:
+    if "gamma" in weights:
+        return "bn"
+    if "depthwise_kernel" in weights:
+        return "separable"
+    if "transpose" in layer_name:
+        return "convT"
+    return "conv"
+
+
+def read_keras_h5(path: str) -> list[_Layer]:
+    """Ordered (model-order) list of weighted layers from a Keras h5 file."""
+    if not HAVE_H5PY:  # pragma: no cover
+        raise ImportError("h5py is required for Keras h5 import")
+    layers: list[_Layer] = []
+    with h5py.File(path, "r") as f:
+        root = f["model_weights"] if "model_weights" in f else f
+        layer_names = [_as_str(n) for n in root.attrs["layer_names"]]
+        for lname in layer_names:
+            group = root[lname]
+            weight_names = [_as_str(n) for n in group.attrs["weight_names"]]
+            if not weight_names:
+                continue  # Activation / pooling / add layers carry no weights
+            weights = {
+                _canon(wn): np.asarray(group[wn]) for wn in weight_names
+            }
+            layers.append(_Layer(lname, _classify(lname, weights), weights))
+    return layers
+
+
+def _as_str(name: Any) -> str:
+    return name.decode() if isinstance(name, bytes) else str(name)
+
+
+def _conv_targets(config: ModelConfig) -> list[str]:
+    """Flax module names of plain Conv2D layers, in Keras model order."""
+    names = ["stem_conv"]
+    names += [f"enc{i}_res" for i in range(len(config.encoder_features))]
+    names += [f"dec{i}_res" for i in range(len(config.decoder_features))]
+    names.append("head")
+    return names
+
+
+def _bn_targets(config: ModelConfig) -> list[str]:
+    names = ["stem_bn"]
+    for i in range(len(config.encoder_features)):
+        names += [f"enc{i}_bn1", f"enc{i}_bn2"]
+    for i in range(len(config.decoder_features)):
+        names += [f"dec{i}_bn1", f"dec{i}_bn2"]
+    return names
+
+
+def _sep_targets(config: ModelConfig) -> list[str]:
+    out = []
+    for i in range(len(config.encoder_features)):
+        out += [f"enc{i}_sep1", f"enc{i}_sep2"]
+    return out
+
+
+def _convT_targets(config: ModelConfig) -> list[str]:
+    out = []
+    for i in range(len(config.decoder_features)):
+        out += [f"dec{i}_convT1", f"dec{i}_convT2"]
+    return out
+
+
+def _check(src: np.ndarray, dst_shape: tuple, layer: str, tensor: str) -> np.ndarray:
+    if tuple(src.shape) != tuple(dst_shape):
+        raise ValueError(
+            f"shape mismatch importing {layer}/{tensor}: "
+            f"h5 {tuple(src.shape)} vs model {tuple(dst_shape)}"
+        )
+    return src
+
+
+def import_resunet_h5(
+    path: str, config: ModelConfig | None = None, template: dict | None = None
+) -> dict:
+    """Import a Keras ResUNet h5 checkpoint as Flax ``{'params','batch_stats'}``.
+
+    ``template`` (a freshly initialized variables pytree) supplies the target
+    structure/shapes; it is built from ``config`` when omitted. Every tensor
+    is shape-checked; extra or missing layers raise.
+    """
+    import jax
+
+    from fedcrack_tpu.models.resunet import init_variables
+
+    config = config or ModelConfig()
+    if template is None:
+        template = init_variables(jax.random.key(0), config)
+    params = _to_mutable(template["params"])
+    stats = _to_mutable(template["batch_stats"])
+
+    layers = read_keras_h5(path)
+    by_kind: dict[str, list[_Layer]] = {}
+    for layer in layers:
+        by_kind.setdefault(layer.kind, []).append(layer)
+
+    targets = {
+        "conv": _conv_targets(config),
+        "separable": _sep_targets(config),
+        "convT": _convT_targets(config),
+        "bn": _bn_targets(config),
+    }
+    for kind, expected in targets.items():
+        got = by_kind.get(kind, [])
+        if len(got) != len(expected):
+            raise ValueError(
+                f"layer count mismatch for {kind}: h5 has {len(got)} "
+                f"({[l.name for l in got]}), model needs {len(expected)} ({expected})"
+            )
+
+    for layer, target in zip(by_kind.get("conv", []), targets["conv"]):
+        w = layer.weights
+        params[target]["kernel"] = _check(
+            w["kernel"], params[target]["kernel"].shape, target, "kernel"
+        )
+        params[target]["bias"] = _check(
+            w["bias"], params[target]["bias"].shape, target, "bias"
+        )
+
+    for layer, target in zip(by_kind.get("separable", []), targets["separable"]):
+        w = layer.weights
+        dw = np.transpose(w["depthwise_kernel"], (0, 1, 3, 2))  # (kh,kw,in,1)->(kh,kw,1,in)
+        params[target]["depthwise"]["kernel"] = _check(
+            dw, params[target]["depthwise"]["kernel"].shape, target, "depthwise"
+        )
+        params[target]["pointwise"]["kernel"] = _check(
+            w["pointwise_kernel"],
+            params[target]["pointwise"]["kernel"].shape,
+            target,
+            "pointwise",
+        )
+        params[target]["pointwise"]["bias"] = _check(
+            w["bias"], params[target]["pointwise"]["bias"].shape, target, "bias"
+        )
+
+    for layer, target in zip(by_kind.get("convT", []), targets["convT"]):
+        w = layer.weights
+        # gradient-of-conv orientation -> Flax: flip spatial, swap channels
+        kt = np.transpose(w["kernel"][::-1, ::-1], (0, 1, 3, 2))
+        params[target]["kernel"] = _check(
+            kt, params[target]["kernel"].shape, target, "kernel"
+        )
+        params[target]["bias"] = _check(
+            w["bias"], params[target]["bias"].shape, target, "bias"
+        )
+
+    for layer, target in zip(by_kind.get("bn", []), targets["bn"]):
+        w = layer.weights
+        params[target]["scale"] = _check(
+            w["gamma"], params[target]["scale"].shape, target, "scale"
+        )
+        params[target]["bias"] = _check(
+            w["beta"], params[target]["bias"].shape, target, "bias"
+        )
+        stats[target]["mean"] = _check(
+            w["moving_mean"], stats[target]["mean"].shape, target, "mean"
+        )
+        stats[target]["var"] = _check(
+            w["moving_variance"], stats[target]["var"].shape, target, "var"
+        )
+
+    return {"params": _to_f32(params), "batch_stats": _to_f32(stats)}
+
+
+def _to_mutable(tree: Any) -> dict:
+    if hasattr(tree, "unfreeze"):
+        tree = tree.unfreeze()
+    return {
+        k: _to_mutable(v) if isinstance(v, dict) or hasattr(v, "unfreeze") else v
+        for k, v in dict(tree).items()
+    }
+
+
+def _to_f32(tree: Any) -> Any:
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: np.asarray(x, np.float32), tree)
+
+
+def main(argv: list[str] | None = None) -> int:
+    """CLI: ``python -m fedcrack_tpu.tools.h5_import ckpt.h5 out.msgpack``."""
+    import argparse
+
+    from fedcrack_tpu.fed.serialization import tree_to_bytes
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("h5_path")
+    p.add_argument("out_path", help="msgpack pytree output (fed/serialization format)")
+    p.add_argument("--img-size", type=int, default=128)
+    p.add_argument("--config", help="JSON FedConfig file; its model section wins")
+    args = p.parse_args(argv)
+    if args.config:
+        from fedcrack_tpu.configs import FedConfig
+
+        with open(args.config) as f:
+            config = FedConfig.from_json(f.read()).model
+    else:
+        config = ModelConfig(img_size=args.img_size)
+    variables = import_resunet_h5(args.h5_path, config)
+    with open(args.out_path, "wb") as f:
+        f.write(tree_to_bytes(variables))
+    print(f"imported {args.h5_path} -> {args.out_path}")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    sys.exit(main())

@@ -79,7 +79,7 @@ class FedServer:
         # Serializes checkpoint writes: orbax CheckpointManager is not
         # thread-safe and saves must land in version order.
         self._ckpt_lock = asyncio.Lock()
-        self._ckpt_tasks: set[asyncio.Task] = set()
+        self._bg_tasks: set[asyncio.Task] = set()
         self._server: grpc.aio.Server | None = None
         self._tick_task: asyncio.Task | None = None
         self.bound_port: int | None = None
@@ -96,8 +96,14 @@ class FedServer:
             state = self.state
         if self._metrics is not None and state.model_version != prev_version:
             # One structured record per completed round (SURVEY.md §5.5 —
-            # the reference printed banners instead).
-            self._metrics.log("round", **state.history[-1])
+            # the reference printed banners instead). Offloaded like the
+            # checkpoint save: a stalled flush must not freeze the loop.
+            entry = state.history[-1]
+            task = asyncio.create_task(
+                asyncio.to_thread(self._metrics.log, "round", **entry)
+            )
+            self._bg_tasks.add(task)
+            task.add_done_callback(self._bg_tasks.discard)
         if self._checkpointer is not None and state.model_version != prev_version:
             # Aggregation happened: persist as a background task so the
             # barrier-completing client's RESP_ARY reply (and the tick loop)
@@ -107,8 +113,8 @@ class FedServer:
             # best-effort relative to protocol liveness: a failed save must
             # not swallow the reply.
             task = asyncio.create_task(self._save_checkpoint(state))
-            self._ckpt_tasks.add(task)
-            task.add_done_callback(self._ckpt_tasks.discard)
+            self._bg_tasks.add(task)
+            task.add_done_callback(self._bg_tasks.discard)
         return reply
 
     async def _save_checkpoint(self, state: R.ServerState) -> None:
@@ -173,8 +179,8 @@ class FedServer:
         if self._tick_task is not None:
             self._tick_task.cancel()
         # Drain in-flight checkpoint saves before shutdown.
-        if self._ckpt_tasks:
-            await asyncio.gather(*tuple(self._ckpt_tasks), return_exceptions=True)
+        if self._bg_tasks:
+            await asyncio.gather(*tuple(self._bg_tasks), return_exceptions=True)
         if self._server is not None:
             await self._server.stop(grace)
 

@@ -164,8 +164,8 @@ def test_fedserver_checkpoints_and_resumes(tmp_path):
             R.TrainDone(cname="b", round=rnd, blob=blob, num_samples=4, now=1.1)
         )
         # saves run as background tasks; drain before the loop closes
-        if server._ckpt_tasks:
-            await asyncio.gather(*tuple(server._ckpt_tasks))
+        if server._bg_tasks:
+            await asyncio.gather(*tuple(server._bg_tasks))
 
     with FedCheckpointer(tmp_path / "ckpt") as ckptr:
         first = FedServer(cfg, variables, checkpointer=ckptr)

@@ -1,0 +1,147 @@
+"""Keras h5 -> Flax importer: tensor-for-tensor forward-pass parity.
+
+Builds the reference's exact U-Net architecture in Keras (from the
+SURVEY.md §2.3 spec: stem Conv/2 + BN + ReLU; encoder blocks of two
+ReLU->SeparableConv->BN then MaxPool(3,/2) with strided 1x1 residual;
+decoder blocks of two ReLU->ConvT->BN then x2 upsample with upsampled 1x1
+residual; 1x1 sigmoid head), saves a legacy full-model h5 (the
+``ModelCheckpoint`` format of test/Segmentation.py:177-179), imports it, and
+checks the Flax model reproduces Keras' forward pass to float tolerance.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import jax
+import jax.numpy as jnp
+
+from fedcrack_tpu.configs import ModelConfig
+from fedcrack_tpu.models import ResUNet
+from fedcrack_tpu.tools.h5_import import import_resunet_h5, read_keras_h5
+
+TINY = ModelConfig(
+    img_size=32, stem_features=4, encoder_features=(8,), decoder_features=(8, 4)
+)
+
+
+def build_keras_resunet(config: ModelConfig) -> "tf.keras.Model":
+    """The reference architecture (SURVEY.md §2.3), in Keras."""
+    layers = tf.keras.layers
+    inputs = tf.keras.Input(shape=config.input_shape)
+    x = layers.Conv2D(config.stem_features, 3, strides=2, padding="same")(inputs)
+    x = layers.BatchNormalization()(x)
+    x = layers.Activation("relu")(x)
+    previous = x
+    for f in config.encoder_features:
+        x = layers.Activation("relu")(x)
+        x = layers.SeparableConv2D(f, 3, padding="same")(x)
+        x = layers.BatchNormalization()(x)
+        x = layers.Activation("relu")(x)
+        x = layers.SeparableConv2D(f, 3, padding="same")(x)
+        x = layers.BatchNormalization()(x)
+        x = layers.MaxPooling2D(3, strides=2, padding="same")(x)
+        residual = layers.Conv2D(f, 1, strides=2, padding="same")(previous)
+        x = layers.add([x, residual])
+        previous = x
+    for f in config.decoder_features:
+        x = layers.Activation("relu")(x)
+        x = layers.Conv2DTranspose(f, 3, padding="same")(x)
+        x = layers.BatchNormalization()(x)
+        x = layers.Activation("relu")(x)
+        x = layers.Conv2DTranspose(f, 3, padding="same")(x)
+        x = layers.BatchNormalization()(x)
+        x = layers.UpSampling2D(2)(x)
+        residual = layers.UpSampling2D(2)(previous)
+        residual = layers.Conv2D(f, 1, padding="same")(residual)
+        x = layers.add([x, residual])
+        previous = x
+    outputs = layers.Conv2D(config.num_classes, 1, padding="same",
+                            activation="sigmoid")(x)
+    return tf.keras.Model(inputs, outputs)
+
+
+def randomize_weights(model: "tf.keras.Model", seed: int = 0) -> None:
+    """Random weights INCLUDING BatchNorm moving stats, so the import parity
+    check exercises the batch_stats path too."""
+    rng = np.random.RandomState(seed)
+    new = []
+    for w in model.get_weights():
+        if w.ndim == 1 and np.all(w >= 0) and np.all(w <= 1) and np.any(w > 0):
+            # moving_variance / gamma start at 1: keep positive
+            new.append(rng.uniform(0.5, 1.5, w.shape).astype(np.float32))
+        else:
+            new.append(rng.normal(0, 0.5, w.shape).astype(np.float32))
+    model.set_weights(new)
+
+
+@pytest.fixture(scope="module")
+def keras_h5(tmp_path_factory):
+    model = build_keras_resunet(TINY)
+    randomize_weights(model)
+    path = tmp_path_factory.mktemp("h5") / "crack_segmentation.h5"
+    model.save(path)  # legacy full-model h5: the reference's checkpoint format
+    return model, str(path)
+
+
+def test_read_keras_h5_layer_inventory(keras_h5):
+    _, path = keras_h5
+    layers = read_keras_h5(path)
+    kinds = [l.kind for l in layers]
+    # tiny config: 1 enc block, 2 dec blocks
+    assert kinds.count("separable") == 2
+    assert kinds.count("convT") == 4
+    assert kinds.count("bn") == 1 + 2 + 4
+    assert kinds.count("conv") == 1 + 1 + 2 + 1  # stem, enc res, dec res, head
+
+
+def test_forward_pass_parity(keras_h5):
+    model, path = keras_h5
+    variables = import_resunet_h5(path, TINY)
+
+    rng = np.random.RandomState(7)
+    images = rng.uniform(0, 1, (2, *TINY.input_shape)).astype(np.float32)
+
+    y_keras = model.predict(images, verbose=0)
+    logits = ResUNet(config=TINY).apply(variables, jnp.asarray(images), train=False)
+    y_flax = np.asarray(jax.nn.sigmoid(logits))
+
+    assert y_flax.shape == y_keras.shape
+    np.testing.assert_allclose(y_flax, y_keras, atol=2e-5, rtol=1e-4)
+
+
+def test_import_shape_mismatch_raises(keras_h5):
+    _, path = keras_h5
+    wrong = ModelConfig(
+        img_size=32, stem_features=8, encoder_features=(8,), decoder_features=(8, 4)
+    )
+    with pytest.raises(ValueError, match="mismatch"):
+        import_resunet_h5(path, wrong)
+
+
+def test_import_layer_count_mismatch_raises(keras_h5):
+    _, path = keras_h5
+    wrong = ModelConfig(
+        img_size=32, stem_features=4, encoder_features=(8, 8), decoder_features=(8, 8, 4)
+    )
+    with pytest.raises(ValueError, match="count mismatch"):
+        import_resunet_h5(path, wrong)
+
+
+def test_imported_variables_are_trainable(keras_h5):
+    """Imported weights slot straight into the training stack."""
+    from fedcrack_tpu.data.synthetic import synth_crack_batch
+    from fedcrack_tpu.train.local import create_train_state, train_step
+
+    _, path = keras_h5
+    variables = import_resunet_h5(path, TINY)
+    state = create_train_state(jax.random.key(0), TINY)
+    state = state.replace_variables(variables)
+    state = state.replace(opt_state=state.tx.init(state.params))
+    images, masks = synth_crack_batch(4, img_size=TINY.img_size, seed=0)
+    state, metrics = train_step(
+        state, (jnp.asarray(images), jnp.asarray(masks)), state.params,
+        jnp.float32(0.0),
+    )
+    assert np.isfinite(float(metrics["loss"]))
