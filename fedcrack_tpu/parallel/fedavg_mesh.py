@@ -34,7 +34,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from fedcrack_tpu.configs import ModelConfig
 from fedcrack_tpu.fed.algorithms import fedprox_penalty
 from fedcrack_tpu.models import ResUNet
-from fedcrack_tpu.ops.losses import iou_counts, iou_from_counts, pixel_accuracy, sigmoid_bce
+from fedcrack_tpu.ops.losses import iou_from_counts
+from fedcrack_tpu.ops.pallas_bce import fused_segmentation_metrics
 from fedcrack_tpu.train.local import make_optimizer
 
 CLIENTS, BATCH = "clients", "batch"
@@ -105,11 +106,13 @@ def build_federated_round(
                     train=True,
                     mutable=["batch_stats"],
                 )
-                bce = sigmoid_bce(logits, msks)
+                # One fused pass for BCE + all statistics (Pallas kernel on
+                # TPU, XLA reference elsewhere — ops/pallas_bce.py).
+                m = fused_segmentation_metrics(logits, msks)
                 prox = fedprox_penalty(p, anchor, mu_arr)
-                return bce + prox, (logits, mutated["batch_stats"])
+                return m["loss"] + prox, (m, mutated["batch_stats"])
 
-            (loss, (logits, new_stats)), grads = jax.value_and_grad(
+            (loss, (m, new_stats)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params)
             # Intra-client data parallelism: one SGD step over the full local
@@ -119,12 +122,11 @@ def build_federated_round(
             new_stats = lax.pmean(new_stats, BATCH)
             updates, new_opt_state = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
-            inter, union = iou_counts(logits, msks)
             metrics = {
                 "loss": lax.pmean(loss, BATCH),
-                "pixel_acc": lax.pmean(pixel_accuracy(logits, msks), BATCH),
-                "iou_inter": lax.psum(inter, BATCH),
-                "iou_union": lax.psum(union, BATCH),
+                "pixel_acc": lax.pmean(m["pixel_acc"], BATCH),
+                "iou_inter": lax.psum(m["iou_inter"], BATCH),
+                "iou_union": lax.psum(m["iou_union"], BATCH),
             }
             return (new_params, new_stats, new_opt_state), metrics
 

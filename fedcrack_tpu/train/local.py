@@ -26,7 +26,8 @@ from flax import core, struct
 from fedcrack_tpu.configs import FedConfig, ModelConfig
 from fedcrack_tpu.fed.algorithms import fedprox_penalty
 from fedcrack_tpu.models import ResUNet
-from fedcrack_tpu.ops.losses import iou_from_counts, segmentation_metrics, sigmoid_bce
+from fedcrack_tpu.ops.losses import iou_from_counts
+from fedcrack_tpu.ops.pallas_bce import fused_segmentation_metrics
 
 
 class TrainState(struct.PyTreeNode):
@@ -102,16 +103,18 @@ def train_step(
             train=True,
             mutable=["batch_stats"],
         )
-        bce = sigmoid_bce(logits, masks)
+        # One fused pass for BCE + all statistics (Pallas kernel on TPU,
+        # XLA reference elsewhere — ops/pallas_bce.py).
+        metrics = fused_segmentation_metrics(logits, masks)
         prox = fedprox_penalty(params, anchor_params, mu)
-        return bce + prox, (logits, mutated["batch_stats"])
+        return metrics["loss"] + prox, (metrics, mutated["batch_stats"])
 
-    (loss, (logits, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+    (loss, (metrics, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
         state.params
     )
     updates, new_opt_state = state.tx.update(grads, state.opt_state, state.params)
     new_params = optax.apply_updates(state.params, updates)
-    metrics = segmentation_metrics(logits, masks)
+    metrics = dict(metrics)
     metrics["loss"] = loss
     new_state = state.replace(
         step=state.step + 1,
@@ -129,7 +132,7 @@ def eval_step(
     """Inference-mode metrics (running BN stats)."""
     images, masks = batch
     logits = state.apply_fn(state.variables, images, train=False)
-    return segmentation_metrics(logits, masks)
+    return fused_segmentation_metrics(logits, masks)
 
 
 def evaluate(state: TrainState, batches: Iterable) -> dict[str, float]:
