@@ -32,6 +32,24 @@ from typing import Iterator, Sequence
 
 import numpy as np
 
+_CV2 = None
+_CV2_PROBED = False
+
+
+def _cv2():
+    """One cv2 import probe per process — a failed import is not cached by
+    Python, and the probe sits on the per-sample decode path."""
+    global _CV2, _CV2_PROBED
+    if not _CV2_PROBED:
+        try:
+            import cv2 as _mod
+
+            _CV2 = _mod
+        except ImportError:
+            _CV2 = None
+        _CV2_PROBED = True
+    return _CV2
+
 
 def list_pairs(image_dir: str, mask_dir: str) -> list[tuple[str, str]]:
     """Paired (image_path, mask_path) lists, matched by filename stem."""
@@ -75,21 +93,40 @@ def reference_split(
 def load_example(
     image_path: str, mask_path: str, img_size: int
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Decode one pair to the reference's tensor contract."""
-    import cv2
+    """Decode one pair to the reference's tensor contract.
 
-    img = cv2.imread(image_path, cv2.IMREAD_COLOR)
-    if img is None:
-        raise FileNotFoundError(image_path)
-    img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
-    img = cv2.resize(img, (img_size, img_size))
-    image = img.astype(np.float32) / 255.0
+    OpenCV when available (its AVX2 fixed-point resize is fastest); otherwise
+    PIL decode + the first-party native resize (fedcrack_tpu.native) — the
+    framework does not hard-require cv2 the way the reference does
+    (client_fit_model.py:12).
+    """
+    cv2 = _cv2()
 
-    m = cv2.imread(mask_path, cv2.IMREAD_GRAYSCALE)
-    if m is None:
-        raise FileNotFoundError(mask_path)
-    m = cv2.resize(m, (img_size, img_size))
-    mask = (m > 0).astype(np.float32)[..., None]
+    if cv2 is not None:
+        img = cv2.imread(image_path, cv2.IMREAD_COLOR)
+        if img is None:
+            raise FileNotFoundError(image_path)
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+        img = cv2.resize(img, (img_size, img_size))
+        image = img.astype(np.float32) / 255.0
+
+        m = cv2.imread(mask_path, cv2.IMREAD_GRAYSCALE)
+        if m is None:
+            raise FileNotFoundError(mask_path)
+        m = cv2.resize(m, (img_size, img_size))
+        mask = (m > 0).astype(np.float32)[..., None]
+        return image, mask
+
+    from PIL import Image
+
+    from fedcrack_tpu import native
+
+    with Image.open(image_path) as im:
+        rgb = np.asarray(im.convert("RGB"), np.uint8)
+    image = native.resize_normalize(rgb, img_size)
+    with Image.open(mask_path) as im:
+        gray = np.asarray(im.convert("L"), np.uint8)
+    mask = native.resize_binarize(gray, img_size)
     return image, mask
 
 

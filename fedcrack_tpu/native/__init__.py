@@ -1,0 +1,258 @@
+"""First-party native host runtime (C++ via ctypes).
+
+Compiles ``fedcrack_native.cpp`` on first import (g++ is in the image;
+pybind11 is not, so the binding is ctypes over an ``extern "C"`` ABI) and
+exposes:
+
+- :func:`resize_normalize` / :func:`resize_binarize` — fused per-sample
+  decode-side transforms (bilinear + /255 or >0 in one pass). These free the
+  framework from a hard OpenCV dependency (the reference requires cv2,
+  client_fit_model.py:12); when cv2 IS present the pipeline prefers its
+  AVX2 fixed-point resize, which benchmarks ~1.4x faster than this scalar
+  float kernel.
+- :func:`weighted_accumulate` / :func:`scale_inplace` — host-plane FedAvg
+  primitives over flat float32 buffers (OpenMP, GIL released);
+- :func:`crc32c` — hardware (SSE4.2) Castagnoli checksum for chunked-upload
+  integrity framing; the reference shipped 100 MB chunks with no checksums
+  (fl_client.py:35-50).
+
+Everything degrades gracefully: when no compiler is available the pure
+numpy/OpenCV paths keep working and :data:`AVAILABLE` is False. The build is
+cached next to the source and rebuilt when the source hash changes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+log = logging.getLogger("fedcrack.native")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "fedcrack_native.cpp")
+
+_lib = None
+_lib_lock = threading.Lock()
+# Tri-state: None = not yet attempted, True = loaded, False = build/load
+# failed (never retried — a broken toolchain must not spawn a g++ subprocess
+# per decoded image).
+AVAILABLE: bool | None = None
+
+
+def _build_dir() -> str:
+    # Per-user, 0700: the .so gets dlopen'd, so a world-writable shared
+    # directory would let another local user plant a library with a matching
+    # source-hash name.
+    d = os.environ.get("FEDCRACK_NATIVE_CACHE")
+    if not d:
+        base = os.environ.get("XDG_CACHE_HOME") or os.path.expanduser("~/.cache")
+        if not os.path.isdir(os.path.dirname(base)) or base.startswith("~"):
+            base = os.path.join(tempfile.gettempdir(), f"fedcrack_{os.getuid()}")
+        d = os.path.join(base, "fedcrack_native")
+    os.makedirs(d, mode=0o700, exist_ok=True)
+    if os.stat(d).st_uid != os.getuid():
+        raise PermissionError(f"native cache dir {d!r} is not owned by this user")
+    return d
+
+
+def _compile() -> str | None:
+    with open(_SRC, "rb") as f:
+        src = f.read()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    try:
+        out = os.path.join(_build_dir(), f"libfedcrack_{tag}.so")
+    except OSError as e:
+        log.warning("native cache unavailable (%s); using fallbacks", e)
+        return None
+    if os.path.exists(out):
+        return out
+    # Unique temp name: concurrent cold-start processes must not interleave
+    # writes into one file; os.replace makes the publish atomic.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(out))
+    os.close(fd)
+    cmd = [
+        "g++", "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
+        "-o", tmp, _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
+    except (OSError, subprocess.SubprocessError) as e:
+        detail = getattr(e, "stderr", b"") or b""
+        log.warning("native build failed (%s); using pure-python fallbacks: %s",
+                    e, detail.decode(errors="replace")[:500])
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    return out
+
+
+def _load():
+    global _lib, AVAILABLE
+    with _lib_lock:
+        if _lib is not None or AVAILABLE is False:
+            return _lib
+        try:
+            path = _compile()
+        except Exception as e:  # never let the fallback path die on build
+            log.warning("native compile raised (%s); using fallbacks", e)
+            path = None
+        if path is None:
+            AVAILABLE = False
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError as e:  # corrupted/foreign .so: degrade, don't crash
+            log.warning("native library load failed (%s); using fallbacks", e)
+            AVAILABLE = False
+            return None
+        lib.fedcrack_resize_u8_f32.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_float, ctypes.c_int, ctypes.c_float,
+        ]
+        lib.fedcrack_weighted_accumulate_f32.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_float, ctypes.c_size_t,
+        ]
+        lib.fedcrack_scale_f32.argtypes = [
+            ctypes.c_void_p, ctypes.c_float, ctypes.c_size_t,
+        ]
+        lib.fedcrack_crc32c.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint32,
+        ]
+        lib.fedcrack_crc32c.restype = ctypes.c_uint32
+        lib.fedcrack_abi_version.restype = ctypes.c_int
+        if lib.fedcrack_abi_version() != 1:
+            log.warning("native ABI mismatch; using fallbacks")
+            AVAILABLE = False
+            return None
+        _lib = lib
+        AVAILABLE = True
+        return _lib
+
+
+def _as_u8_3d(image: np.ndarray) -> np.ndarray:
+    if image.ndim == 2:
+        image = image[..., None]
+    if image.ndim != 3:
+        raise ValueError(f"expected HxW[xC] image, got shape {image.shape}")
+    return np.ascontiguousarray(image, dtype=np.uint8)
+
+
+def _resize(image: np.ndarray, size: int, scale: float, binarize: bool,
+            thresh: float) -> np.ndarray:
+    lib = _load()
+    src = _as_u8_3d(image)
+    h, w, ch = src.shape
+    if lib is None:
+        return _resize_numpy(src, size, scale, binarize, thresh)
+    dst = np.empty((size, size, ch), np.float32)
+    lib.fedcrack_resize_u8_f32(
+        src.ctypes.data, 1, h, w, ch, dst.ctypes.data, size, size,
+        ctypes.c_float(scale), int(binarize), ctypes.c_float(thresh),
+    )
+    return dst
+
+
+def resize_normalize(image: np.ndarray, size: int) -> np.ndarray:
+    """uint8 HxWxC -> float32 size x size x C in [0,1]; bilinear, fused /255
+    (the reference's image contract, client_fit_model.py:30-38)."""
+    return _resize(image, size, 1.0 / 255.0, False, 0.0)
+
+
+def resize_binarize(image: np.ndarray, size: int, thresh: float = 0.0) -> np.ndarray:
+    """uint8 HxW[x1] -> float32 {0,1} size x size x 1; bilinear then ``> thresh``
+    (the reference's mask contract, client_fit_model.py:39-43)."""
+    out = _resize(image, size, 1.0, True, thresh)
+    return out if out.shape[-1] == 1 else out[..., :1]
+
+
+def _resize_numpy(src: np.ndarray, size: int, scale: float, binarize: bool,
+                  thresh: float) -> np.ndarray:
+    """Pure-numpy bilinear with identical half-pixel geometry (fallback and
+    test oracle)."""
+    h, w, ch = src.shape
+    fy = np.clip((np.arange(size) + 0.5) * (h / size) - 0.5, 0, h - 1)
+    fx = np.clip((np.arange(size) + 0.5) * (w / size) - 0.5, 0, w - 1)
+    y0 = fy.astype(np.int64)
+    x0 = fx.astype(np.int64)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (fy - y0).astype(np.float32)[:, None, None]
+    wx = (fx - x0).astype(np.float32)[None, :, None]
+    s = src.astype(np.float32)
+    v = ((1 - wy) * (1 - wx) * s[y0][:, x0]
+         + (1 - wy) * wx * s[y0][:, x1]
+         + wy * (1 - wx) * s[y1][:, x0]
+         + wy * wx * s[y1][:, x1])
+    if binarize:
+        return (v > thresh).astype(np.float32)
+    return v * np.float32(scale)
+
+
+def weighted_accumulate(acc: np.ndarray, x: np.ndarray, w: float) -> None:
+    """In-place ``acc += w * x`` over float32 buffers (host FedAvg inner op,
+    the reference's numpy loop equivalent — fl_server.py:92-102)."""
+    if acc.dtype != np.float32 or x.dtype != np.float32:
+        raise ValueError("weighted_accumulate requires float32 buffers")
+    if acc.shape != x.shape:
+        raise ValueError(f"shape mismatch {acc.shape} vs {x.shape}")
+    lib = _load()
+    if lib is None or not acc.flags.c_contiguous or not x.flags.c_contiguous:
+        acc += np.float32(w) * x
+        return
+    lib.fedcrack_weighted_accumulate_f32(
+        acc.ctypes.data, x.ctypes.data, ctypes.c_float(w), acc.size
+    )
+
+
+def scale_inplace(acc: np.ndarray, s: float) -> None:
+    """In-place ``acc *= s`` (the weighted mean's final divide)."""
+    if acc.dtype != np.float32:
+        raise ValueError("scale_inplace requires a float32 buffer")
+    lib = _load()
+    if lib is None or not acc.flags.c_contiguous:
+        acc *= np.float32(s)
+        return
+    lib.fedcrack_scale_f32(acc.ctypes.data, ctypes.c_float(s), acc.size)
+
+
+def crc32c(data: bytes | bytearray | memoryview, init: int = 0) -> int:
+    """CRC32C (Castagnoli) checksum — chunked-upload integrity framing."""
+    buf = np.frombuffer(bytes(data), np.uint8) if not isinstance(
+        data, np.ndarray
+    ) else data
+    lib = _load()
+    if lib is None:
+        return _crc32c_python(bytes(data), init)
+    if buf.size == 0:
+        return _crc32c_python(b"", init)
+    return int(lib.fedcrack_crc32c(buf.ctypes.data, buf.size, init))
+
+
+_CRC_TABLE: list[int] | None = None
+
+
+def _crc32c_python(data: bytes, init: int = 0) -> int:
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        table = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ (0x82F63B78 if crc & 1 else 0)
+            table.append(crc)
+        _CRC_TABLE = table
+    crc = ~init & 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ _CRC_TABLE[(crc ^ b) & 0xFF]
+    return (~crc) & 0xFFFFFFFF

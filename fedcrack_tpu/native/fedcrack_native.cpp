@@ -1,0 +1,164 @@
+// Native host-runtime kernels for the TPU-side federation framework.
+//
+// The reference's host runtime is native only through its third-party wheels
+// (OpenCV's C++ resize, numpy's C loops — SURVEY.md §2.7); its own input
+// pipeline drives them one Python call per image, synchronously, per batch
+// (reference: client_fit_model.py:30-43, SURVEY.md §3.3 "first-order
+// bottleneck"). This library is the first-party native replacement for the
+// per-sample hot path:
+//
+//   - fused bilinear resize + /255 normalize (images) and resize + >0
+//     binarize (masks), uint8 -> float32 in one pass, OpenMP across rows;
+//   - weighted elementwise accumulate for host-plane FedAvg
+//     (acc += w * x over flattened weight buffers);
+//   - CRC32C (Castagnoli, SSE4.2 hardware when available) for integrity
+//     framing of chunked uploads (reference's 100 MB chunker, fl_client.py:35-50,
+//     shipped chunks with no checksums).
+//
+// Geometry matches OpenCV INTER_LINEAR: half-pixel source centers,
+// src = (dst + 0.5) * (src_size / dst_size) - 0.5, edges clamped.
+//
+// Build: g++ -O3 -fopenmp -shared -fPIC (see native/__init__.py); bound via
+// ctypes — no pybind11 in this image.
+
+#include <algorithm>
+#include <cstddef>
+#include <cstdint>
+#include <cmath>
+
+#if defined(__SSE4_2__)
+#include <nmmintrin.h>
+#endif
+
+extern "C" {
+
+// ---- fused bilinear resize, uint8 -> float32 ----
+//
+// src: [sh, sw, ch] uint8 (C-contiguous), dst: [dh, dw, ch] float32.
+// Each output value is bilinear(src) * scale + (binarize ? threshold step).
+// With binarize != 0, output is 1.0f when the interpolated value > thresh
+// (the reference's mask contract: resize then `> 0`, client_fit_model.py:41).
+static void resize_one(const uint8_t* src, int sh, int sw, int ch,
+                       float* dst, int dh, int dw, float scale,
+                       int binarize, float thresh) {
+  const float ry = static_cast<float>(sh) / static_cast<float>(dh);
+  const float rx = static_cast<float>(sw) / static_cast<float>(dw);
+
+  // Column coefficients depend only on x: compute once, reuse every row.
+  // Serial on purpose: callers parallelize at the sample level (the Python
+  // pipeline's decode ThreadPool, or the batched entry's omp loop below);
+  // an inner omp team here would oversubscribe and thrash caches.
+  int* x0s = new int[dw];
+  int* x1s = new int[dw];
+  float* wxs = new float[dw];
+  for (int x = 0; x < dw; ++x) {
+    float fx = (static_cast<float>(x) + 0.5f) * rx - 0.5f;
+    fx = std::max(0.0f, std::min(fx, static_cast<float>(sw - 1)));
+    x0s[x] = static_cast<int>(fx);
+    x1s[x] = std::min(x0s[x] + 1, sw - 1);
+    wxs[x] = fx - static_cast<float>(x0s[x]);
+  }
+
+  for (int y = 0; y < dh; ++y) {
+    float fy = (static_cast<float>(y) + 0.5f) * ry - 0.5f;
+    fy = std::max(0.0f, std::min(fy, static_cast<float>(sh - 1)));
+    const int y0 = static_cast<int>(fy);
+    const int y1 = std::min(y0 + 1, sh - 1);
+    const float wy = fy - static_cast<float>(y0);
+    const float omwy = 1.0f - wy;
+    float* out_row = dst + static_cast<size_t>(y) * dw * ch;
+    const uint8_t* row0 = src + static_cast<size_t>(y0) * sw * ch;
+    const uint8_t* row1 = src + static_cast<size_t>(y1) * sw * ch;
+    for (int x = 0; x < dw; ++x) {
+      const int x0 = x0s[x] * ch;
+      const int x1 = x1s[x] * ch;
+      const float wx = wxs[x];
+      const float w00 = omwy * (1.0f - wx);
+      const float w01 = omwy * wx;
+      const float w10 = wy * (1.0f - wx);
+      const float w11 = wy * wx;
+      for (int c = 0; c < ch; ++c) {
+        const float v = w00 * row0[x0 + c] + w01 * row0[x1 + c] +
+                        w10 * row1[x0 + c] + w11 * row1[x1 + c];
+        out_row[x * ch + c] =
+            binarize ? (v > thresh ? 1.0f : 0.0f) : v * scale;
+      }
+    }
+  }
+
+  delete[] x0s;
+  delete[] x1s;
+  delete[] wxs;
+}
+
+// Batched entry: src [n, sh, sw, ch] uint8 -> dst [n, dh, dw, ch] float32.
+void fedcrack_resize_u8_f32(const uint8_t* src, int n, int sh, int sw, int ch,
+                            float* dst, int dh, int dw, float scale,
+                            int binarize, float thresh) {
+  const size_t src_stride = static_cast<size_t>(sh) * sw * ch;
+  const size_t dst_stride = static_cast<size_t>(dh) * dw * ch;
+#pragma omp parallel for schedule(dynamic) if (n > 1)
+  for (int i = 0; i < n; ++i) {
+    resize_one(src + i * src_stride, sh, sw, ch, dst + i * dst_stride, dh, dw,
+               scale, binarize, thresh);
+  }
+}
+
+// ---- host-plane FedAvg accumulate: acc += w * x ----
+void fedcrack_weighted_accumulate_f32(float* acc, const float* x, float w,
+                                      size_t n) {
+#pragma omp parallel for simd schedule(static)
+  for (size_t i = 0; i < n; ++i) {
+    acc[i] += w * x[i];
+  }
+}
+
+// in-place scale: acc *= s (the final divide of the weighted mean)
+void fedcrack_scale_f32(float* acc, float s, size_t n) {
+#pragma omp parallel for simd schedule(static)
+  for (size_t i = 0; i < n; ++i) {
+    acc[i] *= s;
+  }
+}
+
+// ---- CRC32C (Castagnoli) ----
+static uint32_t crc32c_table[256];
+static bool crc32c_table_init_done = false;
+
+static void crc32c_table_init() {
+  // bit-reflected polynomial 0x1EDC6F41 -> 0x82F63B78
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t crc = i;
+    for (int j = 0; j < 8; ++j) {
+      crc = (crc >> 1) ^ (0x82F63B78u & (~(crc & 1u) + 1u));
+    }
+    crc32c_table[i] = crc;
+  }
+  crc32c_table_init_done = true;
+}
+
+uint32_t fedcrack_crc32c(const uint8_t* data, size_t len, uint32_t init) {
+  uint32_t crc = ~init;
+#if defined(__SSE4_2__)
+  while (len >= 8) {
+    crc = static_cast<uint32_t>(_mm_crc32_u64(
+        crc, *reinterpret_cast<const uint64_t*>(data)));
+    data += 8;
+    len -= 8;
+  }
+  while (len > 0) {
+    crc = _mm_crc32_u8(crc, *data++);
+    --len;
+  }
+#else
+  if (!crc32c_table_init_done) crc32c_table_init();
+  for (size_t i = 0; i < len; ++i) {
+    crc = (crc >> 8) ^ crc32c_table[(crc ^ data[i]) & 0xFF];
+  }
+#endif
+  return ~crc;
+}
+
+int fedcrack_abi_version() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,109 @@
+"""First-party native library: build, parity, fallbacks.
+
+The C++ kernels must agree exactly with the numpy oracle (identical
+half-pixel bilinear geometry) and track cv2 INTER_LINEAR within its
+fixed-point rounding; CRC32C against the RFC known-answer vector.
+"""
+
+import numpy as np
+import pytest
+
+from fedcrack_tpu import native
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.RandomState(42)
+
+
+def test_native_builds_and_loads():
+    native._load()
+    assert native.AVAILABLE, "g++ is in the image; the native build must succeed"
+
+
+def test_resize_normalize_matches_numpy_oracle(rng):
+    img = rng.randint(0, 256, (97, 203, 3), np.uint8)  # odd sizes
+    out = native.resize_normalize(img, 64)
+    ref = native._resize_numpy(img, 64, 1 / 255.0, False, 0.0)
+    assert out.shape == (64, 64, 3) and out.dtype == np.float32
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_resize_binarize_matches_numpy_oracle(rng):
+    m = rng.randint(0, 256, (97, 203), np.uint8)
+    out = native.resize_binarize(m, 64)
+    ref = native._resize_numpy(m[..., None], 64, 1.0, True, 0.0)
+    assert out.shape == (64, 64, 1)
+    np.testing.assert_array_equal(out, ref)
+    assert set(np.unique(out)).issubset({0.0, 1.0})
+
+
+def test_resize_tracks_cv2_within_fixed_point_rounding(rng):
+    cv2 = pytest.importorskip("cv2")
+    img = rng.randint(0, 256, (448, 448, 3), np.uint8)
+    out = native.resize_normalize(img, 128)
+    ref = cv2.resize(img, (128, 128)).astype(np.float32) / 255.0
+    # cv2 INTER_LINEAR uses 11-bit fixed-point weights; ~1 LSB differences
+    np.testing.assert_allclose(out, ref, atol=3 / 255.0)
+
+
+def test_upscale_geometry(rng):
+    img = rng.randint(0, 256, (16, 16, 3), np.uint8)
+    out = native.resize_normalize(img, 32)
+    ref = native._resize_numpy(img, 32, 1 / 255.0, False, 0.0)
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_crc32c_known_answer():
+    # RFC 3720 test vector
+    assert native.crc32c(b"123456789") == 0xE3069283
+    assert native._crc32c_python(b"123456789") == 0xE3069283
+    assert native.crc32c(b"") == 0
+    # streaming/chaining not supported; full-buffer parity native vs python
+    data = bytes(range(256)) * 13
+    assert native.crc32c(data) == native._crc32c_python(data)
+
+
+def test_weighted_accumulate_and_scale(rng):
+    acc = rng.randn(4097).astype(np.float32)
+    x = rng.randn(4097).astype(np.float32)
+    expect = acc + np.float32(0.375) * x
+    native.weighted_accumulate(acc, x, 0.375)
+    # FMA contraction (g++ -O3 -march=native) rounds once where numpy
+    # rounds twice: 1-ulp differences are expected
+    np.testing.assert_allclose(acc, expect, rtol=1e-5, atol=1e-6)
+    expect = acc * np.float32(0.5)
+    native.scale_inplace(acc, 0.5)
+    np.testing.assert_allclose(acc, expect, rtol=1e-6, atol=1e-7)
+
+
+def test_weighted_accumulate_validates():
+    with pytest.raises(ValueError, match="float32"):
+        native.weighted_accumulate(
+            np.zeros(4, np.float64), np.zeros(4, np.float32), 1.0
+        )
+    with pytest.raises(ValueError, match="mismatch"):
+        native.weighted_accumulate(
+            np.zeros(4, np.float32), np.zeros(5, np.float32), 1.0
+        )
+
+
+def test_load_example_without_cv2(tmp_path, monkeypatch, rng):
+    """The pipeline decodes via PIL + native when cv2 is unavailable."""
+    from PIL import Image
+
+    from fedcrack_tpu.data import pipeline
+
+    img = rng.randint(0, 256, (64, 64, 3), np.uint8)
+    mask = (rng.uniform(size=(64, 64)) > 0.7).astype(np.uint8) * 255
+    img_p = tmp_path / "a.png"
+    mask_p = tmp_path / "m.png"
+    Image.fromarray(img).save(img_p)
+    Image.fromarray(mask, mode="L").save(mask_p)
+
+    monkeypatch.setattr(pipeline, "_CV2", None)
+    monkeypatch.setattr(pipeline, "_CV2_PROBED", True)
+    image, m = pipeline.load_example(str(img_p), str(mask_p), 32)
+    assert image.shape == (32, 32, 3) and image.dtype == np.float32
+    assert m.shape == (32, 32, 1) and set(np.unique(m)).issubset({0.0, 1.0})
+    assert 0.0 <= image.min() and image.max() <= 1.0
