@@ -27,6 +27,7 @@ import ctypes
 import hashlib
 import logging
 import os
+import platform
 import subprocess
 import tempfile
 import threading
@@ -57,15 +58,37 @@ def _build_dir() -> str:
             base = os.path.join(tempfile.gettempdir(), f"fedcrack_{os.getuid()}")
         d = os.path.join(base, "fedcrack_native")
     os.makedirs(d, mode=0o700, exist_ok=True)
-    if os.stat(d).st_uid != os.getuid():
+    st = os.stat(d)
+    if st.st_uid != os.getuid():
         raise PermissionError(f"native cache dir {d!r} is not owned by this user")
+    # makedirs(mode=...) does not chmod a pre-existing directory; a
+    # group/world-writable cache would let another user pre-plant a .so
+    # under the predictable hash name.
+    if st.st_mode & 0o077:
+        os.chmod(d, 0o700)
     return d
+
+
+def _cpu_tag() -> str:
+    # The .so is built -march=native; a cache dir shared across machines
+    # (NFS home, XDG_CACHE_HOME) must not serve e.g. AVX-512 code to a CPU
+    # without it (SIGILL at first kernel call, not at load time).
+    feats = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feats = line
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256((platform.machine() + feats).encode()).hexdigest()[:8]
 
 
 def _compile() -> str | None:
     with open(_SRC, "rb") as f:
         src = f.read()
-    tag = hashlib.sha256(src).hexdigest()[:16]
+    tag = hashlib.sha256(src).hexdigest()[:16] + "_" + _cpu_tag()
     try:
         out = os.path.join(_build_dir(), f"libfedcrack_{tag}.so")
     except OSError as e:
@@ -98,6 +121,11 @@ def _compile() -> str | None:
 
 def _load():
     global _lib, AVAILABLE
+    # Lock-free fast path: after one-time init this runs on the per-sample
+    # decode and per-tensor FedAvg hot paths, where a contended global lock
+    # would serialize the decode worker threads.
+    if _lib is not None or AVAILABLE is False:
+        return _lib
     with _lib_lock:
         if _lib is not None or AVAILABLE is False:
             return _lib
@@ -169,9 +197,14 @@ def resize_normalize(image: np.ndarray, size: int) -> np.ndarray:
     return _resize(image, size, 1.0 / 255.0, False, 0.0)
 
 
-def resize_binarize(image: np.ndarray, size: int, thresh: float = 0.0) -> np.ndarray:
+def resize_binarize(image: np.ndarray, size: int, thresh: float = 0.5) -> np.ndarray:
     """uint8 HxW[x1] -> float32 {0,1} size x size x 1; bilinear then ``> thresh``
-    (the reference's mask contract, client_fit_model.py:39-43)."""
+    (the reference's mask contract, client_fit_model.py:39-43).
+
+    The default ``thresh=0.5`` reproduces the reference's uint8-domain
+    ``resize(mask) > 0``: cv2 rounds the interpolated value to nearest int,
+    so a pixel survives iff the float interpolation is >= 0.5 — keeping the
+    cv2 and native decode paths label-identical at mask boundaries."""
     out = _resize(image, size, 1.0, True, thresh)
     return out if out.shape[-1] == 1 else out[..., :1]
 
@@ -226,16 +259,19 @@ def scale_inplace(acc: np.ndarray, s: float) -> None:
     lib.fedcrack_scale_f32(acc.ctypes.data, ctypes.c_float(s), acc.size)
 
 
-def crc32c(data: bytes | bytearray | memoryview, init: int = 0) -> int:
-    """CRC32C (Castagnoli) checksum — chunked-upload integrity framing."""
-    buf = np.frombuffer(bytes(data), np.uint8) if not isinstance(
-        data, np.ndarray
-    ) else data
+def crc32c(data: bytes | bytearray | memoryview | np.ndarray, init: int = 0) -> int:
+    """CRC32C (Castagnoli) checksum — chunked-upload integrity framing.
+
+    ndarray input is checksummed over its full C-order byte image (any dtype,
+    any layout), identically in the native and pure-Python paths.
+    """
+    if isinstance(data, np.ndarray):
+        buf = np.ascontiguousarray(data).view(np.uint8).ravel()
+    else:
+        buf = np.frombuffer(bytes(data), np.uint8)
     lib = _load()
-    if lib is None:
-        return _crc32c_python(bytes(data), init)
-    if buf.size == 0:
-        return _crc32c_python(b"", init)
+    if lib is None or buf.size == 0:
+        return _crc32c_python(buf.tobytes(), init)
     return int(lib.fedcrack_crc32c(buf.ctypes.data, buf.size, init))
 
 

@@ -24,6 +24,7 @@
 #include <algorithm>
 #include <cstddef>
 #include <cstdint>
+#include <cstring>
 #include <cmath>
 
 #if defined(__SSE4_2__)
@@ -122,27 +123,34 @@ void fedcrack_scale_f32(float* acc, float s, size_t n) {
 }
 
 // ---- CRC32C (Castagnoli) ----
-static uint32_t crc32c_table[256];
-static bool crc32c_table_init_done = false;
-
-static void crc32c_table_init() {
-  // bit-reflected polynomial 0x1EDC6F41 -> 0x82F63B78
-  for (uint32_t i = 0; i < 256; ++i) {
-    uint32_t crc = i;
-    for (int j = 0; j < 8; ++j) {
-      crc = (crc >> 1) ^ (0x82F63B78u & (~(crc & 1u) + 1u));
+struct Crc32cTable {
+  uint32_t t[256];
+  Crc32cTable() {
+    // bit-reflected polynomial 0x1EDC6F41 -> 0x82F63B78
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t crc = i;
+      for (int j = 0; j < 8; ++j) {
+        crc = (crc >> 1) ^ (0x82F63B78u & (~(crc & 1u) + 1u));
+      }
+      t[i] = crc;
     }
-    crc32c_table[i] = crc;
   }
-  crc32c_table_init_done = true;
+};
+
+// C++11 magic static: thread-safe one-time init (ctypes calls arrive with
+// the GIL released, so concurrent first use is real).
+static const uint32_t* crc32c_table() {
+  static const Crc32cTable tbl;
+  return tbl.t;
 }
 
 uint32_t fedcrack_crc32c(const uint8_t* data, size_t len, uint32_t init) {
   uint32_t crc = ~init;
 #if defined(__SSE4_2__)
   while (len >= 8) {
-    crc = static_cast<uint32_t>(_mm_crc32_u64(
-        crc, *reinterpret_cast<const uint64_t*>(data)));
+    uint64_t v;  // memcpy: well-defined unaligned load, compiles to one mov
+    std::memcpy(&v, data, 8);
+    crc = static_cast<uint32_t>(_mm_crc32_u64(crc, v));
     data += 8;
     len -= 8;
   }
@@ -151,9 +159,9 @@ uint32_t fedcrack_crc32c(const uint8_t* data, size_t len, uint32_t init) {
     --len;
   }
 #else
-  if (!crc32c_table_init_done) crc32c_table_init();
+  const uint32_t* table = crc32c_table();
   for (size_t i = 0; i < len; ++i) {
-    crc = (crc >> 8) ^ crc32c_table[(crc ^ data[i]) & 0xFF];
+    crc = (crc >> 8) ^ table[(crc ^ data[i]) & 0xFF];
   }
 #endif
   return ~crc;

@@ -64,6 +64,31 @@ def test_crc32c_known_answer():
     assert native.crc32c(data) == native._crc32c_python(data)
 
 
+def test_mask_binarize_matches_cv2(rng):
+    """The PIL+native fallback must produce label-identical masks to the cv2
+    path (uint8-domain resize-then->0), or training targets silently differ
+    at mask boundaries depending on which decoder is installed."""
+    cv2 = pytest.importorskip("cv2")
+    for trial in range(5):
+        h, w = rng.randint(40, 300, 2)
+        m = (rng.uniform(size=(h, w)) > 0.7).astype(np.uint8) * 255
+        via_cv2 = (cv2.resize(m, (64, 64)) > 0).astype(np.float32)
+        via_native = native.resize_binarize(m, 64)[..., 0]
+        np.testing.assert_array_equal(via_cv2, via_native)
+
+
+def test_crc32c_ndarray_inputs(rng):
+    """ndarray checksums cover the full C-order byte image regardless of
+    dtype or layout, and agree with the checksum of the equivalent bytes."""
+    f = rng.randn(37).astype(np.float32)
+    assert native.crc32c(f) == native.crc32c(f.tobytes())
+    noncontig = rng.randint(0, 256, 64, np.uint8)[::2]
+    assert native.crc32c(noncontig) == native.crc32c(noncontig.tobytes())
+    multi = rng.randn(5, 7).astype(np.float64)
+    assert native.crc32c(multi) == native.crc32c(multi.tobytes())
+    assert native.crc32c(np.empty(0, np.uint8)) == 0
+
+
 def test_weighted_accumulate_and_scale(rng):
     acc = rng.randn(4097).astype(np.float32)
     x = rng.randn(4097).astype(np.float32)
