@@ -15,3 +15,10 @@ from fedcrack_tpu.parallel.fedavg_mesh import (  # noqa: F401
     mesh_fedavg,
     stack_client_data,
 )
+from fedcrack_tpu.parallel.spatial import (  # noqa: F401
+    build_spatial_predict,
+    build_spatial_train_step,
+    halo_exchange,
+    make_spatial_mesh,
+    spatial_apply,
+)

@@ -25,8 +25,9 @@ def make_mesh(
     n_clients: int,
     n_batch: int = 1,
     devices: Sequence[jax.Device] | None = None,
+    axis_names: tuple[str, str] = ("clients", "batch"),
 ) -> Mesh:
-    """Build a ``Mesh`` with axes ``('clients', 'batch')``.
+    """Build a two-axis ``Mesh`` (default axes ``('clients', 'batch')``).
 
     Uses the first ``n_clients * n_batch`` devices. Raises if the host does
     not expose enough devices (the caller decides whether to shrink the
@@ -38,8 +39,8 @@ def make_mesh(
     need = n_clients * n_batch
     if len(devs) < need:
         raise ValueError(
-            f"mesh ({n_clients} clients x {n_batch} batch) needs {need} devices, "
-            f"host exposes {len(devs)}"
+            f"mesh ({n_clients} {axis_names[0]} x {n_batch} {axis_names[1]}) "
+            f"needs {need} devices, host exposes {len(devs)}"
         )
     grid = np.asarray(devs[:need], dtype=object).reshape(n_clients, n_batch)
-    return Mesh(grid, ("clients", "batch"))
+    return Mesh(grid, axis_names)

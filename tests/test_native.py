@@ -32,10 +32,22 @@ def test_resize_normalize_matches_numpy_oracle(rng):
 def test_resize_binarize_matches_numpy_oracle(rng):
     m = rng.randint(0, 256, (97, 203), np.uint8)
     out = native.resize_binarize(m, 64)
-    ref = native._resize_numpy(m[..., None], 64, 1.0, True, 0.0)
+    # Same thresh on both sides — resize_binarize defaults to 0.5.
+    ref = native._resize_numpy(m[..., None], 64, 1.0, True, 0.5)
     assert out.shape == (64, 64, 1)
     np.testing.assert_array_equal(out, ref)
     assert set(np.unique(out)).issubset({0.0, 1.0})
+
+
+def test_resize_binarize_sparse_mask_threshold():
+    # A single lit pixel interpolates into (0, 0.5] around its neighborhood;
+    # the 0.5 default must agree with the oracle there too (this is exactly
+    # the case a thresh mismatch between test and implementation hides).
+    m = np.zeros((97, 203), np.uint8)
+    m[10, 10] = 1
+    out = native.resize_binarize(m, 64)
+    ref = native._resize_numpy(m[..., None], 64, 1.0, True, 0.5)
+    np.testing.assert_array_equal(out, ref)
 
 
 def test_resize_tracks_cv2_within_fixed_point_rounding(rng):
