@@ -92,7 +92,15 @@ def main(argv: list[str] | None = None) -> int:
     train_fn, holder = make_train_fn(
         cfg, dataset, batch, seed=args.seed, metrics_logger=metrics_logger
     )
-    client = FedClient(cfg, train_fn, cname=args.name)
+    # Ship the per-round metrics JSONL to the coordinator's log sink after
+    # the final round (reference C2.1/C1.5 — its 'L' upload path existed but
+    # was never called, fl_client.py:110-118).
+    client = FedClient(
+        cfg,
+        train_fn,
+        cname=args.name,
+        upload_paths=(cfg.metrics_path,) if cfg.metrics_path else (),
+    )
     result = client.run_session()
     if metrics_logger is not None:
         metrics_logger.log(

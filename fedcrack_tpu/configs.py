@@ -100,6 +100,10 @@ class FedConfig:
     # JSONL structured-metrics file (per-round records, SURVEY.md §5.5);
     # empty disables.
     metrics_path: str = ""
+    # Server-side sink directory for client-uploaded log files (the
+    # reference's 'L' chunk path wrote TensorBoard events under ./logs,
+    # fl_server.py:84-89); empty keeps uploads in memory only.
+    logs_dir: str = ""
     # jax.profiler trace directory for training spans; empty disables.
     profile_dir: str = ""
     # Msgpack pytree seeding the initial global model (e.g. from the Keras h5

@@ -74,11 +74,16 @@ class TrainingNotice:
 
 @dataclass(frozen=True)
 class LogChunk:
-    """Client ships a log/event-file chunk (reference 'L', fl_server.py:170-175)."""
+    """Client ships a log/event-file chunk (reference 'L', fl_server.py:170-175).
+
+    ``offset`` is the byte position of this chunk in the file: appends are
+    idempotent under RPC retries (a resent chunk overwrites itself instead of
+    duplicating), and ``offset=0`` restarts the upload."""
     cname: str
     title: str
     data: bytes
     now: float
+    offset: int = 0
 
 
 @dataclass(frozen=True)
@@ -138,6 +143,17 @@ class ServerState:
 
     def _replace(self, **kw) -> "ServerState":
         return dataclasses.replace(self, **kw)
+
+
+def drop_log(state: ServerState, cname: str, title: str) -> ServerState:
+    """Forget an accumulated upload (called after the transport flushes it
+    to disk, so server memory does not grow with every upload)."""
+    key = f"{cname}/{title}"
+    if key not in state.logs:
+        return state
+    logs = dict(state.logs)
+    del logs[key]
+    return state._replace(logs=logs)
 
 
 def initial_state(config: FedConfig, global_variables: Any) -> ServerState:
@@ -260,10 +276,19 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
         case TrainingNotice():
             return state, Reply(status="OK", title="T")
 
-        case LogChunk(cname=cname, title=title, data=data):
+        case LogChunk(cname=cname, title=title, data=data, offset=offset):
             key = f"{cname}/{title}"
             logs = dict(state.logs)
-            logs[key] = logs.get(key, b"") + data
+            buf = logs.get(key, b"")
+            if offset > len(buf):
+                return state, Reply(
+                    status=REJECTED,
+                    title=f"log chunk gap: offset {offset}, have {len(buf)}",
+                )
+            # Writing at the declared offset makes retried chunks overwrite
+            # themselves rather than duplicate, and offset=0 restarts cleanly
+            # after a failed or already-flushed upload.
+            logs[key] = buf[:offset] + data
             return state._replace(logs=logs), Reply(status="OK", title=title)
 
         case TrainDone(cname=cname, round=rnd, blob=blob, num_samples=ns, now=now):

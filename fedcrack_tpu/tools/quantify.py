@@ -137,3 +137,70 @@ def predict_and_quantify(
             )
             done += 1
     return reports
+
+
+def main(argv=None) -> None:
+    """``python -m fedcrack_tpu.tools.quantify`` — the reference's inference +
+    crack-quantification script (test/Segmentation2.py) as a real CLI: load
+    trained weights, predict masks, write overlays, print per-image stats."""
+    import argparse
+    import json
+
+    import jax
+
+    from fedcrack_tpu.configs import ModelConfig
+    from fedcrack_tpu.data.pipeline import ArrayDataset, CrackDataset, list_pairs
+    from fedcrack_tpu.data.synthetic import synth_crack_batch
+    from fedcrack_tpu.fed.serialization import tree_from_bytes
+    from fedcrack_tpu.train.local import create_train_state
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--weights", required=True, help="msgpack pytree (best.msgpack)")
+    p.add_argument("--image-dir")
+    p.add_argument("--mask-dir")
+    p.add_argument("--synthetic", type=int, default=0, help="use N generated samples")
+    p.add_argument("--img-size", type=int, default=128)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--out-dir", default="contour")  # reference wrote contour/imgN.jpg
+    p.add_argument("--max-images", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    model_config = ModelConfig(img_size=args.img_size)
+    state = create_train_state(jax.random.key(args.seed), model_config)
+    with open(args.weights, "rb") as f:
+        variables = tree_from_bytes(f.read(), template=state.variables)
+    state = state.replace_variables(variables)
+
+    # Inference must see every image: clamp the batch to the dataset size
+    # and keep partial tail batches (drop_last=False).
+    if args.synthetic:
+        images, masks = synth_crack_batch(args.synthetic, args.img_size, seed=args.seed)
+        dataset = ArrayDataset(
+            images,
+            masks,
+            batch_size=min(args.batch, args.synthetic),
+            seed=args.seed,
+            drop_last=False,
+        )
+    elif args.image_dir and args.mask_dir:
+        pairs = list_pairs(args.image_dir, args.mask_dir)
+        dataset = CrackDataset(
+            pairs,
+            img_size=args.img_size,
+            batch_size=min(args.batch, len(pairs)),
+            seed=args.seed,
+            drop_last=False,
+        )
+    else:
+        p.error("need --image-dir/--mask-dir or --synthetic N")
+
+    reports = predict_and_quantify(
+        state, dataset, out_dir=args.out_dir, max_images=args.max_images
+    )
+    for r in reports:
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

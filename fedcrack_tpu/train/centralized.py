@@ -58,7 +58,83 @@ def train_centralized(
     return state, history
 
 
+def _build_datasets(args, model_config: ModelConfig):
+    """Train/val datasets from real paired dirs or synthetic fixtures,
+    preserving the reference's split semantics (held-out validation tail,
+    test/Segmentation.py:84-90)."""
+    from fedcrack_tpu.data import CrackDataset, list_pairs, reference_split
+    from fedcrack_tpu.data.pipeline import ArrayDataset
+    from fedcrack_tpu.data.synthetic import synth_crack_batch
+
+    if args.synthetic:
+        if args.synthetic < 2:
+            raise SystemExit("--synthetic needs at least 2 samples (train + val)")
+        n_val = max(1, args.synthetic // 5)
+        images, masks = synth_crack_batch(
+            args.synthetic, model_config.img_size, seed=args.seed
+        )
+        train = ArrayDataset(
+            images[n_val:],
+            masks[n_val:],
+            batch_size=min(args.batch, args.synthetic - n_val),
+            seed=args.seed,
+        )
+        val = ArrayDataset(
+            images[:n_val], masks[:n_val], batch_size=min(args.batch, n_val), seed=args.seed
+        )
+        return train, val
+    if not (args.image_dir and args.mask_dir):
+        raise SystemExit("need --image-dir/--mask-dir or --synthetic N")
+    pairs = list_pairs(args.image_dir, args.mask_dir)
+    train_pairs, val_pairs = reference_split(pairs, args.train_samples, args.split_seed)
+    # reference_split guarantees val >= 1, never >= batch — clamp so a small
+    # validation tail still yields batches instead of crashing at startup.
+    kw = dict(img_size=model_config.img_size, seed=args.seed)
+    return (
+        CrackDataset(train_pairs, batch_size=min(args.batch, len(train_pairs)), **kw),
+        CrackDataset(val_pairs, batch_size=min(args.batch, len(val_pairs)), **kw),
+    )
+
+
+def main(argv=None) -> None:
+    """``python -m fedcrack_tpu.train.centralized`` — the reference's
+    standalone trainer (test/Segmentation.py) as a real CLI."""
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--image-dir")
+    p.add_argument("--mask-dir")
+    p.add_argument("--synthetic", type=int, default=0, help="use N generated samples")
+    p.add_argument("--epochs", type=int, default=60)  # test/Segmentation.py:185
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--img-size", type=int, default=128)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--train-samples", type=int, default=6213)
+    p.add_argument("--split-seed", type=int, default=1337)
+    p.add_argument("--out-dir", default="centralized_out")
+    args = p.parse_args(argv)
+
+    model_config = ModelConfig(img_size=args.img_size)
+    train, val = _build_datasets(args, model_config)
+    _, history = train_centralized(
+        train,
+        val,
+        model_config=model_config,
+        epochs=args.epochs,
+        learning_rate=args.lr,
+        out_dir=args.out_dir,
+        seed=args.seed,
+    )
+    best = min(h["val_loss"] for h in history)
+    print(f"done: {len(history)} epochs, best val_loss={best:.4f} -> {args.out_dir}")
+
+
 def _save(state: TrainState, path: str) -> None:
     os.makedirs(os.path.dirname(path), exist_ok=True)
     with open(path, "wb") as f:
         f.write(tree_to_bytes(state.variables))
+
+
+if __name__ == "__main__":
+    main()

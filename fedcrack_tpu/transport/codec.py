@@ -51,7 +51,13 @@ def event_from_message(msg: pb.ClientMessage, now: float) -> R.Event:
     if kind == "training":
         return R.TrainingNotice(cname=cname, now=now)
     if kind == "log":
-        return R.LogChunk(cname=cname, title=msg.log.title, data=msg.log.data, now=now)
+        return R.LogChunk(
+            cname=cname,
+            title=msg.log.title,
+            data=msg.log.data,
+            now=now,
+            offset=msg.log.offset,
+        )
     if kind == "done":
         return R.TrainDone(
             cname=cname,

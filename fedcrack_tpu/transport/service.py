@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from typing import Any, AsyncIterator, Callable
 
@@ -33,6 +34,14 @@ log = logging.getLogger("fedcrack.server")
 
 SERVICE_NAME = "fedcrack.FedControl"
 METHOD = "Session"
+
+
+def _safe_component(name: str) -> str:
+    """One path component from an untrusted wire string: separators and
+    parent references become underscores, never a traversal."""
+    cleaned = name.replace("\\", "_").replace("/", "_").replace("..", "_")
+    cleaned = cleaned.strip() or "_"
+    return cleaned.lstrip(".") or "_"
 
 
 def channel_options(max_message_mb: int) -> list[tuple[str, int]]:
@@ -149,8 +158,43 @@ class FedServer:
                 yield pb.ServerMessage(status=R.REJECTED, title=str(e))
                 continue
             reply = await self._apply(event)
+            if (
+                isinstance(event, R.LogChunk)
+                and msg.log.last
+                and self.config.logs_dir
+            ):
+                # Final chunk of an upload: flush the accumulated bytes to
+                # the log sink (reference C1.5 wrote client TensorBoard
+                # events under ./logs with string-surgery re-rooting,
+                # fl_server.py:84-89; here the path is sanitized).
+                await self._flush_log(event.cname, event.title)
             log.debug("%s -> %s", type(event).__name__, reply.status)
             yield message_from_reply(reply)
+
+    async def _flush_log(self, cname: str, title: str) -> None:
+        data = self.state.logs.get(f"{cname}/{title}")
+        if data is None:
+            return
+        path = os.path.join(
+            self.config.logs_dir, _safe_component(cname), _safe_component(title)
+        )
+
+        def write() -> None:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "wb") as f:
+                f.write(data)
+
+        try:
+            await asyncio.to_thread(write)
+            log.info("log upload %s/%s -> %s (%d bytes)", cname, title, path, len(data))
+        except OSError:
+            log.exception("failed to flush log upload %s/%s", cname, title)
+            return
+        async with self._lock:
+            # Drop the flushed buffer so memory does not grow with uploads —
+            # unless a fresh upload for the same title already started.
+            if self.state.logs.get(f"{cname}/{title}") == data:
+                self.state = R.drop_log(self.state, cname, title)
 
     def _build(self) -> grpc.aio.Server:
         server = grpc.aio.server(options=channel_options(self.config.max_message_mb))

@@ -191,8 +191,12 @@ def test_deadline_shrinks_cohort():
 
 def test_log_chunks_accumulate():
     state = enroll_two(boot())
-    state, r = R.transition(state, R.LogChunk("a", "events.tb", b"abc", now=2.0))
-    state, r = R.transition(state, R.LogChunk("a", "events.tb", b"def", now=2.1))
+    state, r = R.transition(
+        state, R.LogChunk("a", "events.tb", b"abc", now=2.0, offset=0)
+    )
+    state, r = R.transition(
+        state, R.LogChunk("a", "events.tb", b"def", now=2.1, offset=3)
+    )
     assert state.logs["a/events.tb"] == b"abcdef"
 
 
@@ -202,3 +206,31 @@ def test_single_writer_purity_no_shared_mutation():
     s0 = boot()
     s1, _ = R.transition(s0, R.Ready("a", now=0.0))
     assert s0.cohort == frozenset() and s1.cohort == {"a"}
+
+
+def test_log_chunk_offsets_idempotent_and_gap_rejected():
+    """Retried chunks overwrite themselves (offset-addressed writes), a
+    fresh offset=0 upload restarts the buffer, and a gap is rejected."""
+    from fedcrack_tpu.configs import FedConfig
+
+    cfg = FedConfig(cohort_size=1)
+    state = R.initial_state(cfg, {"params": {"w": np.zeros(2, np.float32)}})
+    chunk = lambda data, off: R.LogChunk(
+        cname="c", title="t", data=data, now=0.0, offset=off
+    )
+    state, rep = R.transition(state, chunk(b"abcd", 0))
+    assert rep.status == "OK"
+    state, _ = R.transition(state, chunk(b"efgh", 4))
+    # RPC retry of the second chunk: same bytes, same offset — no duplication
+    state, rep = R.transition(state, chunk(b"efgh", 4))
+    assert rep.status == "OK" and state.logs["c/t"] == b"abcdefgh"
+    # gap (lost chunk) is an explicit rejection, not silent corruption
+    _, rep = R.transition(state, chunk(b"zz", 100))
+    assert rep.status == R.REJECTED
+    # offset=0 restarts the upload (e.g. after a flush or failed attempt)
+    state, _ = R.transition(state, chunk(b"new", 0))
+    assert state.logs["c/t"] == b"new"
+    # drop_log forgets the buffer and is a no-op for unknown keys
+    state = R.drop_log(state, "c", "t")
+    assert "c/t" not in state.logs
+    assert R.drop_log(state, "c", "t").logs == state.logs

@@ -192,3 +192,39 @@ def test_dead_client_mid_round_cohort_shrinks(session_cfg):
     assert res["a"].rounds_completed == 3
     assert state.phase == R.PHASE_FINISHED
     assert state.cohort == frozenset({"a"})
+
+
+def test_chunked_log_upload_roundtrip(session_cfg, tmp_path):
+    """C2.1/C1.5: the client streams a file in chunks; the server accumulates
+    and flushes it to logs_dir on the last chunk, with untrusted names
+    sanitized (the reference's path came from title[11:] string surgery,
+    fl_server.py:84-89)."""
+    cfg = dataclasses.replace(session_cfg, cohort_size=1, logs_dir=str(tmp_path / "sink"))
+    payload = bytes(range(256)) * 1024  # 256 KiB, multiple chunks at 64 KiB
+    src = tmp_path / "client-metrics.jsonl"
+    src.write_bytes(payload)
+
+    server = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
+    with ServerThread(server) as st:
+        client = FedClient(
+            cfg, _fake_train(1.0, 10), cname="a", port=st.port,
+            upload_paths=(str(src),),
+        )
+        # upload_file standalone, small chunks to force several messages
+        client.upload_file(str(src), title="../evil/../../escape", chunk_bytes=64 * 1024)
+        result = client.run_session()  # session-end upload of upload_paths
+        state = st.state
+
+    assert result.rounds_completed == cfg.max_rounds
+    # flushed buffers are dropped from memory (unbounded-growth guard)
+    assert state.logs == {}
+    # disk flush: sanitized path inside the sink, exact bytes
+    sink = tmp_path / "sink"
+    flushed = sorted(p for p in sink.rglob("*") if p.is_file())
+    assert [p.name for p in flushed] == sorted(
+        ["__evil_____escape", "client-metrics.jsonl"]
+    ), flushed
+    for p in flushed:
+        assert p.read_bytes() == payload
+        assert p.parent == sink / "a"
+        assert sink in p.parents  # no traversal out of the sink
