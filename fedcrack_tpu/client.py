@@ -16,8 +16,7 @@ import logging
 import sys
 
 from fedcrack_tpu.configs import FedConfig
-from fedcrack_tpu.data.pipeline import ArrayDataset, CrackDataset, list_pairs, reference_split
-from fedcrack_tpu.data.synthetic import synth_crack_batch
+from fedcrack_tpu.data.pipeline import dataset_from_source, reference_split
 from fedcrack_tpu.train.federated import make_train_fn
 from fedcrack_tpu.transport.client import FedClient
 
@@ -63,26 +62,24 @@ def main(argv: list[str] | None = None) -> int:
         cfg = dataclasses.replace(cfg, **overrides)
 
     batch = cfg.data.batch_size
-    if args.synthetic:
-        images, masks = synth_crack_batch(
-            args.synthetic, cfg.model.img_size, seed=args.seed
-        )
-        dataset = ArrayDataset(images, masks, batch_size=batch, seed=args.seed)
-    elif args.image_dir and args.mask_dir:
-        pairs = list_pairs(args.image_dir, args.mask_dir)
-        train_pairs, _ = reference_split(
-            pairs, cfg.data.train_samples, cfg.data.split_seed
-        )
-        dataset = CrackDataset(
-            train_pairs,
+    try:
+        dataset = dataset_from_source(
+            args.synthetic,
+            args.image_dir,
+            args.mask_dir,
             img_size=cfg.model.img_size,
             batch_size=batch,
             seed=args.seed,
             num_workers=cfg.data.num_workers,
             prefetch=cfg.data.prefetch,
+            # Local shard = the reference's train side of the seeded split
+            # (client_fit_model.py:76-82).
+            pair_filter=lambda pairs: reference_split(
+                pairs, cfg.data.train_samples, cfg.data.split_seed
+            )[0],
         )
-    else:
-        p.error("need --image-dir/--mask-dir or --synthetic N")
+    except ValueError as e:
+        p.error(str(e))
 
     metrics_logger = None
     if cfg.metrics_path:
@@ -92,15 +89,7 @@ def main(argv: list[str] | None = None) -> int:
     train_fn, holder = make_train_fn(
         cfg, dataset, batch, seed=args.seed, metrics_logger=metrics_logger
     )
-    # Ship the per-round metrics JSONL to the coordinator's log sink after
-    # the final round (reference C2.1/C1.5 — its 'L' upload path existed but
-    # was never called, fl_client.py:110-118).
-    client = FedClient(
-        cfg,
-        train_fn,
-        cname=args.name,
-        upload_paths=(cfg.metrics_path,) if cfg.metrics_path else (),
-    )
+    client = FedClient(cfg, train_fn, cname=args.name)
     result = client.run_session()
     if metrics_logger is not None:
         metrics_logger.log(
@@ -109,6 +98,16 @@ def main(argv: list[str] | None = None) -> int:
             rounds_completed=result.rounds_completed,
         )
         metrics_logger.close()
+    if cfg.metrics_path and result.enrolled:
+        # Ship the complete per-round metrics JSONL — session summary
+        # included, hence after the logger closes — to the coordinator's log
+        # sink (reference C2.1/C1.5: its 'L' upload path existed but was
+        # never called, fl_client.py:110-118). Best-effort: the server only
+        # lingers briefly after FIN.
+        try:
+            client.upload_file(cfg.metrics_path)
+        except Exception:
+            logging.warning("metrics upload failed", exc_info=True)
     logging.info(
         "session done: enrolled=%s rounds=%d", result.enrolled, result.rounds_completed
     )

@@ -1,5 +1,7 @@
 from fedcrack_tpu.data.pipeline import (  # noqa: F401
+    ArrayDataset,
     CrackDataset,
+    dataset_from_source,
     list_pairs,
     load_example,
     reference_split,

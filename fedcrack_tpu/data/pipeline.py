@@ -322,3 +322,61 @@ def device_prefetch(iterator, size: int = 2):
         except StopIteration:
             pass
         yield nxt
+
+
+def dataset_from_source(
+    synthetic: int,
+    image_dir: str | None,
+    mask_dir: str | None,
+    *,
+    img_size: int,
+    batch_size: int,
+    seed: int = 0,
+    drop_last: bool = True,
+    num_workers: int | None = None,
+    prefetch: int | None = None,
+    pair_filter=None,
+):
+    """One dataset from either source the CLIs accept: ``--synthetic N``
+    (generated fixtures -> :class:`ArrayDataset`) or paired
+    ``--image-dir/--mask-dir`` (-> :class:`CrackDataset`). Shared by the
+    client, centralized-trainer and quantifier entry points so batch
+    clamping and error behavior stay consistent.
+
+    ``pair_filter`` selects a subset of the listed pairs (e.g. one side of
+    :func:`reference_split`). The batch size is clamped to the dataset size
+    so small datasets yield batches instead of crashing at startup.
+    """
+    from fedcrack_tpu.data.synthetic import synth_crack_batch
+
+    if synthetic:
+        images, masks = synth_crack_batch(synthetic, img_size, seed=seed)
+        return ArrayDataset(
+            images,
+            masks,
+            batch_size=max(1, min(batch_size, len(images))),
+            seed=seed,
+            drop_last=drop_last,
+        )
+    if not (image_dir and mask_dir):
+        raise ValueError("need --image-dir/--mask-dir or --synthetic N")
+    pairs = list_pairs(image_dir, mask_dir)
+    if pair_filter is not None:
+        pairs = pair_filter(pairs)
+    if not pairs:
+        raise ValueError(
+            f"no image/mask pairs selected from {image_dir!r}/{mask_dir!r}"
+        )
+    kw = {}
+    if num_workers is not None:
+        kw["num_workers"] = num_workers
+    if prefetch is not None:
+        kw["prefetch"] = prefetch
+    return CrackDataset(
+        pairs,
+        img_size=img_size,
+        batch_size=max(1, min(batch_size, len(pairs))),
+        seed=seed,
+        drop_last=drop_last,
+        **kw,
+    )

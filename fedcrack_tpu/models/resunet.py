@@ -85,9 +85,16 @@ class SeparableConv(nn.Module):
 
 
 class ResUNet(nn.Module):
-    """The crack-segmentation residual U-Net. Returns per-pixel logits."""
+    """The crack-segmentation residual U-Net. Returns per-pixel logits.
+
+    ``bn_axis_name``: when training under ``shard_map`` with the batch split
+    across a mesh axis, set this to that axis so BatchNorm moments
+    pmean-synchronize across the data-parallel shards — keeping the sharded
+    step numerically identical to the single-device one. Inference is
+    unaffected (running stats)."""
 
     config: ModelConfig = ModelConfig()
+    bn_axis_name: str | None = None
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
@@ -105,6 +112,7 @@ class ResUNet(nn.Module):
                 epsilon=_BN_EPSILON,
                 dtype=dtype,
                 param_dtype=pdtype,
+                axis_name=self.bn_axis_name,
                 name=name,
             )
 

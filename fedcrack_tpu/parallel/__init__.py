@@ -15,6 +15,11 @@ from fedcrack_tpu.parallel.fedavg_mesh import (  # noqa: F401
     mesh_fedavg,
     stack_client_data,
 )
+from fedcrack_tpu.parallel.multihost import (  # noqa: F401
+    global_mesh_devices,
+    initialize_if_needed,
+    is_coordinator,
+)
 from fedcrack_tpu.parallel.spatial import (  # noqa: F401
     build_spatial_predict,
     build_spatial_train_step,

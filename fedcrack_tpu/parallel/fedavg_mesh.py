@@ -15,9 +15,10 @@ round is a single ``shard_map`` over ``Mesh(('clients', 'batch'))``:
 
 BatchNorm moving statistics are carried per client and averaged with the
 kernels, matching the reference's implicit behavior (``get_weights()``
-includes BN moments — SURVEY.md §7 "hard parts"). Normalization inside the
-step uses per-device batch moments (standard non-sync BN across the DP axis);
-the *running* stats are pmean'd so every replica leaves the round identical.
+includes BN moments — SURVEY.md §7 "hard parts"). BatchNorm is
+**sync-BN over the ``batch`` axis** (flax ``axis_name``), so the round is
+invariant to how a client's batch is split across its DP shards — the
+(clients=C, batch=B) mesh trains exactly like (clients=C, batch=1).
 """
 
 from __future__ import annotations
@@ -80,10 +81,11 @@ def build_federated_round(
     client_fit_model.py:155-157; here only the optimizer moments reset).
     """
     model_config = model_config or ModelConfig()
-    model = ResUNet(config=model_config)
+    model = ResUNet(config=model_config, bn_axis_name=BATCH)
     tx = make_optimizer(learning_rate)
     mu = float(fedprox_mu)
     n_client_shards = mesh.shape[CLIENTS]
+    n_batch_shards = mesh.shape[BATCH]
 
     def client_fit(variables, images, masks, active, n_samples):
         # Per-shard blocks: leading clients-axis block is exactly one client.
@@ -115,10 +117,13 @@ def build_federated_round(
             (loss, (m, new_stats)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params)
-            # Intra-client data parallelism: one SGD step over the full local
-            # batch, gradients and running BN stats averaged across the
-            # `batch` axis replicas.
-            grads = lax.pmean(grads, BATCH)
+            # Intra-client data parallelism: `params` is unvarying over the
+            # `batch` axis, so shard_map's AD already psums the per-shard
+            # cotangents; dividing by the shard count turns that sum of
+            # local-mean gradients into the gradient of the client's
+            # full-batch mean loss (a pmean here would be an identity on the
+            # already-summed value and double-count by the shard count).
+            grads = jax.tree_util.tree_map(lambda g: g / n_batch_shards, grads)
             new_stats = lax.pmean(new_stats, BATCH)
             updates, new_opt_state = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)

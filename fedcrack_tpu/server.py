@@ -43,6 +43,12 @@ def build_config(argv: list[str] | None = None) -> FedConfig:
         help="JSONL file for structured per-round metrics (SURVEY.md §5.5)",
     )
     p.add_argument(
+        "--logs-dir",
+        dest="logs_dir",
+        help="sink directory for client-uploaded log files (reference 'L' "
+        "path, fl_server.py:84-89); empty keeps uploads in memory",
+    )
+    p.add_argument(
         "--init-weights",
         dest="init_weights",
         help="seed the global model from a msgpack pytree (e.g. produced by "
@@ -67,6 +73,7 @@ def build_config(argv: list[str] | None = None) -> FedConfig:
         ("ckpt_dir", "ckpt_dir"),
         ("seed", "seed"),
         ("metrics_path", "metrics_path"),
+        ("logs_dir", "logs_dir"),
         ("init_weights", "init_weights"),
     ]:
         val = getattr(args, flag)

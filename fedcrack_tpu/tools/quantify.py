@@ -149,8 +149,6 @@ def main(argv=None) -> None:
     import jax
 
     from fedcrack_tpu.configs import ModelConfig
-    from fedcrack_tpu.data.pipeline import ArrayDataset, CrackDataset, list_pairs
-    from fedcrack_tpu.data.synthetic import synth_crack_batch
     from fedcrack_tpu.fed.serialization import tree_from_bytes
     from fedcrack_tpu.train.local import create_train_state
 
@@ -172,28 +170,22 @@ def main(argv=None) -> None:
         variables = tree_from_bytes(f.read(), template=state.variables)
     state = state.replace_variables(variables)
 
-    # Inference must see every image: clamp the batch to the dataset size
-    # and keep partial tail batches (drop_last=False).
-    if args.synthetic:
-        images, masks = synth_crack_batch(args.synthetic, args.img_size, seed=args.seed)
-        dataset = ArrayDataset(
-            images,
-            masks,
-            batch_size=min(args.batch, args.synthetic),
-            seed=args.seed,
-            drop_last=False,
-        )
-    elif args.image_dir and args.mask_dir:
-        pairs = list_pairs(args.image_dir, args.mask_dir)
-        dataset = CrackDataset(
-            pairs,
+    from fedcrack_tpu.data.pipeline import dataset_from_source
+
+    # Inference must see every image: drop_last=False keeps tail batches,
+    # and the shared builder clamps the batch to the dataset size.
+    try:
+        dataset = dataset_from_source(
+            args.synthetic,
+            args.image_dir,
+            args.mask_dir,
             img_size=args.img_size,
-            batch_size=min(args.batch, len(pairs)),
+            batch_size=args.batch,
             seed=args.seed,
             drop_last=False,
         )
-    else:
-        p.error("need --image-dir/--mask-dir or --synthetic N")
+    except ValueError as e:
+        p.error(str(e))
 
     reports = predict_and_quantify(
         state, dataset, out_dir=args.out_dir, max_images=args.max_images

@@ -62,8 +62,11 @@ def _build_datasets(args, model_config: ModelConfig):
     """Train/val datasets from real paired dirs or synthetic fixtures,
     preserving the reference's split semantics (held-out validation tail,
     test/Segmentation.py:84-90)."""
-    from fedcrack_tpu.data import CrackDataset, list_pairs, reference_split
-    from fedcrack_tpu.data.pipeline import ArrayDataset
+    from fedcrack_tpu.data.pipeline import (
+        ArrayDataset,
+        dataset_from_source,
+        reference_split,
+    )
     from fedcrack_tpu.data.synthetic import synth_crack_batch
 
     if args.synthetic:
@@ -83,17 +86,31 @@ def _build_datasets(args, model_config: ModelConfig):
             images[:n_val], masks[:n_val], batch_size=min(args.batch, n_val), seed=args.seed
         )
         return train, val
-    if not (args.image_dir and args.mask_dir):
-        raise SystemExit("need --image-dir/--mask-dir or --synthetic N")
-    pairs = list_pairs(args.image_dir, args.mask_dir)
-    train_pairs, val_pairs = reference_split(pairs, args.train_samples, args.split_seed)
-    # reference_split guarantees val >= 1, never >= batch — clamp so a small
-    # validation tail still yields batches instead of crashing at startup.
-    kw = dict(img_size=model_config.img_size, seed=args.seed)
-    return (
-        CrackDataset(train_pairs, batch_size=min(args.batch, len(train_pairs)), **kw),
-        CrackDataset(val_pairs, batch_size=min(args.batch, len(val_pairs)), **kw),
-    )
+    # Real dirs: the reference's seeded split, val = held-out tail
+    # (test/Segmentation.py:84-90). The shared builder clamps batch sizes so
+    # a small validation tail still yields batches, and a split side that
+    # comes back empty (e.g. a single-pair directory) is a clear startup
+    # error rather than a crash.
+    def split_side(i):
+        def pick(pairs):
+            return reference_split(pairs, args.train_samples, args.split_seed)[i]
+
+        return pick
+
+    try:
+        train = dataset_from_source(
+            0, args.image_dir, args.mask_dir,
+            img_size=model_config.img_size, batch_size=args.batch,
+            seed=args.seed, pair_filter=split_side(0),
+        )
+        val = dataset_from_source(
+            0, args.image_dir, args.mask_dir,
+            img_size=model_config.img_size, batch_size=args.batch,
+            seed=args.seed, pair_filter=split_side(1),
+        )
+    except ValueError as e:
+        raise SystemExit(str(e))
+    return train, val
 
 
 def main(argv=None) -> None:

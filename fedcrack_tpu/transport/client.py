@@ -216,7 +216,15 @@ class FedClient:
                     msg.log.data = data
                     msg.log.offset = offset
                     msg.log.last = last
-                    self._call(method, msg)
+                    rep = self._call(method, msg)
+                    if rep.status != "OK":
+                        # e.g. the server lost its buffer (restart/flush) and
+                        # rejected a gapped offset — surface it instead of
+                        # streaming the rest into the void.
+                        raise RuntimeError(
+                            f"log upload of {path!r} rejected at offset "
+                            f"{offset}: {rep.title}"
+                        )
                     offset += len(data)
                     if last:
                         break

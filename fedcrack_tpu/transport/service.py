@@ -161,6 +161,7 @@ class FedServer:
             if (
                 isinstance(event, R.LogChunk)
                 and msg.log.last
+                and reply.status == "OK"  # a rejected chunk must not flush
                 and self.config.logs_dir
             ):
                 # Final chunk of an upload: flush the accumulated bytes to

@@ -132,3 +132,41 @@ def test_crack_density():
     d = crack_density(masks)
     assert d.shape == (6,)
     assert (d > 0).all()
+
+
+def test_dataset_from_source_synthetic_clamps_batch():
+    from fedcrack_tpu.data import dataset_from_source
+
+    ds = dataset_from_source(
+        4, None, None, img_size=32, batch_size=16, drop_last=False
+    )
+    batches = list(ds)
+    assert sum(b[0].shape[0] for b in batches) == 4  # every sample seen
+
+
+def test_dataset_from_source_dirs_and_filter(tmp_path):
+    from fedcrack_tpu.data import dataset_from_source, write_synthetic_dataset
+
+    write_synthetic_dataset(str(tmp_path), 6, img_size=32)
+    ds = dataset_from_source(
+        0,
+        str(tmp_path / "images"),
+        str(tmp_path / "masks"),
+        img_size=32,
+        batch_size=4,
+        pair_filter=lambda pairs: pairs[:3],
+    )
+    assert len(ds.pairs) == 3 and ds.batch_size == 3  # clamped
+
+    with pytest.raises(ValueError, match="no image/mask pairs"):
+        dataset_from_source(
+            0,
+            str(tmp_path / "images"),
+            str(tmp_path / "masks"),
+            img_size=32,
+            batch_size=4,
+            pair_filter=lambda pairs: [],
+        )
+
+    with pytest.raises(ValueError, match="image-dir"):
+        dataset_from_source(0, None, None, img_size=32, batch_size=4)

@@ -106,20 +106,66 @@ class TestMeshMatchesHost:
         want = _host_round(variables, images, masks, active, n_samples, 1e-3)
         _assert_trees_match(got, want)
 
-    def test_intra_client_batch_dp_runs(self):
-        """4 clients x 2-way batch DP on the same 8 devices; per-device BN
-        moments differ from the single-device path so this checks execution
-        + finiteness, not bitwise parity."""
+    def test_intra_client_batch_dp_matches_host(self):
+        """4 clients x 2-way batch DP trains exactly like the single-device
+        host path: BN is synced over the `batch` axis and gradients are
+        mean (not sum) over the DP shards, so splitting a client's batch
+        across chips must not change the result."""
         mesh = make_mesh(4, 2)
         images, masks = _client_data(4)
         variables = create_train_state(jax.random.key(1), TINY).variables
-        round_fn = build_federated_round(mesh, TINY, local_epochs=2)
-        got, metrics = round_fn(
-            variables, images, masks, np.ones(4, np.float32), np.full(4, 8.0, np.float32)
+        active = np.ones(4, np.float32)
+        n_samples = np.full(4, 8.0, np.float32)
+        round_fn = build_federated_round(
+            mesh, TINY, learning_rate=1e-3, local_epochs=2
         )
-        for leaf in jax.tree_util.tree_leaves(got):
-            assert np.all(np.isfinite(np.asarray(leaf)))
+        got, metrics = round_fn(variables, images, masks, active, n_samples)
+        want = _host_round(variables, images, masks, active, n_samples, 1e-3, epochs=2)
+        # 2 epochs of cross-shard collectives accumulate a little more fp
+        # reassociation noise than the batch=1 path.
+        _assert_trees_match(got, want, atol=5e-5)
         assert metrics["loss"].shape == (4,)
+
+    def test_dp_gradient_not_double_counted(self, monkeypatch):
+        """Regression: `params` is batch-unvarying, so shard_map AD psums the
+        grad cotangents over the `batch` axis; the step must divide by the
+        shard count. With SGD(1.0) the applied update IS the gradient —
+        duplicated batch halves make per-shard data identical, so the
+        2-shard update must equal the 1-shard one (a double-count shows up
+        as an exact 2x)."""
+        import optax
+
+        import fedcrack_tpu.parallel.fedavg_mesh as fm
+
+        monkeypatch.setattr(fm, "make_optimizer", lambda lr: optax.sgd(1.0))
+        imgs4, msks4 = synth_crack_batch(4, img_size=TINY.img_size, seed=0)
+        images, masks = stack_client_data(
+            [(np.concatenate([imgs4, imgs4]), np.concatenate([msks4, msks4]))],
+            steps=1,
+            batch_size=8,
+        )
+        variables = create_train_state(jax.random.key(0), TINY).variables
+        active = np.ones(1, np.float32)
+        n_samples = np.full(1, 8.0, np.float32)
+
+        deltas = {}
+        for nb in (1, 2):
+            round_fn = fm.build_federated_round(
+                make_mesh(1, nb), TINY, learning_rate=1.0, local_epochs=1
+            )
+            new_vars, _ = round_fn(variables, images, masks, active, n_samples)
+            new_vars = jax.device_get(new_vars)
+            deltas[nb] = jax.tree_util.tree_map(
+                lambda old, new: np.asarray(old) - np.asarray(new),
+                jax.device_get(variables)["params"],
+                new_vars["params"],
+            )
+        g1 = jax.tree_util.tree_leaves(deltas[1])
+        g2 = jax.tree_util.tree_leaves(deltas[2])
+        ratio = sum(float(np.vdot(a, b)) for a, b in zip(g1, g2)) / sum(
+            float(np.vdot(a, a)) for a in g1
+        )
+        assert 0.999 < ratio < 1.001, f"DP gradient scale off: ratio={ratio}"
 
     def test_all_dropped_cohort_raises(self):
         """active == 0 everywhere must raise, not silently zero the model
