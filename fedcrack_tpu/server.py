@@ -12,6 +12,7 @@ import asyncio
 import json
 import logging
 import sys
+from typing import Any
 
 import jax
 
@@ -20,7 +21,7 @@ from fedcrack_tpu.train.local import create_train_state
 from fedcrack_tpu.transport.service import FedServer
 
 
-def build_config(argv: list[str] | None = None) -> FedConfig:
+def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--config", help="JSON FedConfig file (flags override it)")
     p.add_argument("--rounds", type=int, help="max federation rounds")
@@ -42,6 +43,16 @@ def build_config(argv: list[str] | None = None) -> FedConfig:
         dest="metrics_path",
         help="JSONL file for structured per-round metrics (SURVEY.md §5.5)",
     )
+    p.add_argument(
+        "--eval-synthetic",
+        type=int,
+        default=0,
+        help="evaluate the global model each round on N generated samples "
+        "(the reference designed per-round server-side eval but never "
+        "enabled it, fl_server.py:27-37)",
+    )
+    p.add_argument("--eval-image-dir", help="server-side eval images")
+    p.add_argument("--eval-mask-dir", help="server-side eval masks")
     p.add_argument(
         "--logs-dir",
         dest="logs_dir",
@@ -84,18 +95,40 @@ def build_config(argv: list[str] | None = None) -> FedConfig:
 
         cfg = dataclasses.replace(cfg, **overrides)
     logging.info("config: %s", json.loads(cfg.to_json()))
-    return cfg
+    return cfg, args
 
 
 def main(argv: list[str] | None = None) -> int:
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
     )
-    cfg = build_config(argv)
+    cfg, args = build_config(argv)
     # Build + serialize the initial global model (the reference delegates
     # this to the missing model_evaluate module, SURVEY.md §2.5).
     state = create_train_state(jax.random.key(cfg.seed), cfg.model, cfg.learning_rate)
     variables = state.variables
+    eval_fn = None
+    if args.eval_synthetic or (args.eval_image_dir and args.eval_mask_dir):
+        from fedcrack_tpu.data.pipeline import dataset_from_source
+        from fedcrack_tpu.fed.serialization import tree_from_bytes
+        from fedcrack_tpu.train.local import evaluate
+
+        eval_dataset = dataset_from_source(
+            args.eval_synthetic,
+            args.eval_image_dir,
+            args.eval_mask_dir,
+            img_size=cfg.model.img_size,
+            batch_size=cfg.data.batch_size,
+            seed=cfg.seed + 1,  # never the clients' train fixtures
+            drop_last=False,
+        )
+
+        def eval_fn(blob: bytes) -> dict:
+            st = state.replace_variables(
+                tree_from_bytes(blob, template=state.variables)
+            )
+            return evaluate(st, eval_dataset)
+
     if cfg.init_weights:
         from fedcrack_tpu.fed.serialization import tree_from_bytes
 
@@ -112,8 +145,12 @@ def main(argv: list[str] | None = None) -> int:
         from fedcrack_tpu.obs import MetricsLogger
 
         metrics = MetricsLogger(cfg.metrics_path)
-    server = FedServer(cfg, variables, checkpointer=checkpointer, metrics=metrics)
+    server = FedServer(
+        cfg, variables, checkpointer=checkpointer, metrics=metrics, eval_fn=eval_fn
+    )
     final = asyncio.run(server.serve_until_finished())
+    for entry in server.eval_history:
+        logging.info("server eval %s", entry)
     if metrics is not None:
         metrics.close()
     logging.info(

@@ -63,6 +63,7 @@ class FedServer:
         tick_period_s: float = 1.0,
         checkpointer: Any | None = None,
         metrics: Any | None = None,
+        eval_fn: Callable[[bytes], dict] | None = None,
     ):
         self.config = config
         self.state = R.initial_state(config, global_variables)
@@ -82,6 +83,12 @@ class FedServer:
                 )
                 self.state = resumed
         self._metrics = metrics
+        # Per-round evaluation of the freshly aggregated global model
+        # (the reference designed this — trainNextRound, fl_server.py:27-37 —
+        # but its call site is commented out; here it runs for real).
+        # eval_fn(global_blob) -> {"loss": ..., "iou": ..., ...}.
+        self._eval_fn = eval_fn
+        self.eval_history: list[dict] = []
         self._clock = clock
         self._tick_period_s = tick_period_s
         self._lock = asyncio.Lock()
@@ -113,6 +120,10 @@ class FedServer:
             )
             self._bg_tasks.add(task)
             task.add_done_callback(self._bg_tasks.discard)
+        if self._eval_fn is not None and state.model_version != prev_version:
+            task = asyncio.create_task(self._run_eval(state))
+            self._bg_tasks.add(task)
+            task.add_done_callback(self._bg_tasks.discard)
         if self._checkpointer is not None and state.model_version != prev_version:
             # Aggregation happened: persist as a background task so the
             # barrier-completing client's RESP_ARY reply (and the tick loop)
@@ -137,6 +148,20 @@ class FedServer:
                     "checkpoint save failed for model_version %d",
                     state.model_version,
                 )
+
+    async def _run_eval(self, state: R.ServerState) -> None:
+        """Evaluate the round's aggregated model off the serving path."""
+        rnd = state.history[-1]["round"] if state.history else state.current_round
+        try:
+            result = await asyncio.to_thread(self._eval_fn, state.global_blob)
+        except Exception:
+            log.exception("server-side eval failed for round %s", rnd)
+            return
+        entry = {"round": rnd, "model_version": state.model_version, **result}
+        self.eval_history.append(entry)
+        log.info("global model eval: %s", entry)
+        if self._metrics is not None:
+            await asyncio.to_thread(self._metrics.log, "server_eval", **entry)
 
     async def _tick_forever(self) -> None:
         """Drives pure time effects: enrollment-window close and round

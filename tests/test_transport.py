@@ -228,3 +228,30 @@ def test_chunked_log_upload_roundtrip(session_cfg, tmp_path):
         assert p.read_bytes() == payload
         assert p.parent == sink / "a"
         assert sink in p.parents  # no traversal out of the sink
+
+
+def test_server_side_eval_runs_per_round(session_cfg, tmp_path):
+    """The reference designed per-round eval of the fresh global model but
+    never enabled it (trainNextRound, fl_server.py:27-37); here it runs
+    after every aggregation, off the serving path."""
+    calls = []
+
+    def eval_fn(blob):
+        tree = tree_from_bytes(blob)
+        calls.append(float(tree["params"]["w"].mean()))
+        return {"loss": 0.5, "iou": 0.25}
+
+    cfg = dataclasses.replace(session_cfg, cohort_size=1, max_rounds=2)
+    server = FedServer(cfg, _vars(0.0), tick_period_s=0.05, eval_fn=eval_fn)
+    with ServerThread(server) as st:
+        result = FedClient(
+            cfg, _fake_train(1.0, 10), cname="a", port=st.port
+        ).run_session()
+
+    assert result.rounds_completed == 2
+    assert len(server.eval_history) == 2
+    # evaluated the AGGREGATED weights of each round (w + 1, then w + 2)
+    assert calls == [pytest.approx(1.0), pytest.approx(2.0)]
+    assert server.eval_history[0]["round"] == 1
+    assert server.eval_history[1]["model_version"] == 2
+    assert all(e["loss"] == 0.5 for e in server.eval_history)
