@@ -85,6 +85,12 @@ class FedConfig:
     round_deadline_s: float = 0.0  # 0 = no deadline
     # FedProx proximal term; 0 disables (plain FedAvg).
     fedprox_mu: float = 0.0
+    # FedOpt server optimizer on the round pseudo-gradient (Reddi et al.):
+    # "avg" = plain FedAvg (the reference's behavior), "momentum"/"fedavgm",
+    # "adam"/"fedadam". Applied to params only; BN stats are plain-averaged.
+    server_optimizer: str = "avg"
+    server_lr: float = 1.0
+    server_momentum: float = 0.9
     # Advertised model type. The reference advertises the vestigial string
     # "mobilenet_v2" (fl_server.py:75) while actually sharing the U-Net; we
     # advertise honestly but accept the legacy alias (SURVEY.md §2.2(3)).

@@ -60,3 +60,46 @@ def fedprox_penalty(params: Any, anchor: Any, mu: float) -> jax.Array:
         anchor,
     )
     return 0.5 * mu * jax.tree_util.tree_reduce(jnp.add, sq, jnp.zeros((), jnp.float32))
+
+
+# ---- FedOpt: server-side optimizers on the round pseudo-gradient ----
+#
+# (Reddi et al., "Adaptive Federated Optimization".) FedAvg treats the round
+# as "replace the global model with the client average"; FedOpt treats
+# ``global - average`` as a pseudo-gradient and feeds it to a server
+# optimizer, giving FedAvgM (momentum) and FedAdam. The reference has plain
+# FedAvg only (fl_server.py:92-105); ``server_optimizer="avg"`` reproduces
+# it exactly. Only ``params`` go through the optimizer — BatchNorm moving
+# statistics are plain-averaged (momentum on running moments is meaningless).
+
+
+def make_server_optimizer(kind: str, lr: float = 1.0, momentum: float = 0.9):
+    """An optax transform for the server update, or None for plain FedAvg."""
+    import optax
+
+    if kind in ("", "avg", "fedavg", "none"):
+        return None
+    if kind in ("momentum", "fedavgm"):
+        return optax.sgd(lr, momentum=momentum)
+    if kind in ("adam", "fedadam"):
+        return optax.adam(lr, b1=0.9, b2=0.99, eps=1e-3)  # paper defaults
+    raise ValueError(f"unknown server optimizer {kind!r}")
+
+
+def apply_server_opt(global_params, avg_params, tx, opt_state):
+    """One FedOpt step: pseudo-gradient = global - average (so SGD with
+    lr=1, no momentum, recovers plain FedAvg). Returns (new_params,
+    new_opt_state)."""
+    import optax
+
+    grad = jax.tree_util.tree_map(
+        lambda g, a: g.astype(jnp.float32) - a.astype(jnp.float32),
+        global_params,
+        avg_params,
+    )
+    updates, new_opt_state = tx.update(grad, opt_state, global_params)
+    new_params = optax.apply_updates(global_params, updates)
+    new_params = jax.tree_util.tree_map(
+        lambda n, g: n.astype(g.dtype), new_params, global_params
+    )
+    return new_params, new_opt_state

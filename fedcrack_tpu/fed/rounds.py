@@ -32,7 +32,11 @@ from dataclasses import dataclass
 from typing import Any, Mapping
 
 from fedcrack_tpu.configs import FedConfig
-from fedcrack_tpu.fed.algorithms import fedavg
+from fedcrack_tpu.fed.algorithms import (
+    apply_server_opt,
+    fedavg,
+    make_server_optimizer,
+)
 from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
 
 # ---- status codes (reference vocabulary, §2.4) ----
@@ -140,6 +144,9 @@ class ServerState:
     # client log sink: title -> accumulated bytes (reference C1.5)
     logs: Mapping[str, bytes] = dataclasses.field(default_factory=dict)
     history: tuple[dict, ...] = ()
+    # FedOpt server-optimizer state (momentum/Adam moments); None for plain
+    # FedAvg. Lazily initialized on the first aggregation.
+    server_opt_state: Any = None
 
     def _replace(self, **kw) -> "ServerState":
         return dataclasses.replace(self, **kw)
@@ -210,12 +217,28 @@ def _advance_time(state: ServerState, now: float) -> ServerState:
 
 
 def _aggregate(state: ServerState, now: float) -> ServerState:
-    """FedAvg over the round's received updates; advance round/version."""
+    """FedAvg (optionally + FedOpt server step) over the round's received
+    updates; advance round/version."""
     names = sorted(state.received.keys())
     trees = [tree_from_bytes(state.received[n][0]) for n in names]
     counts = [state.received[n][1] for n in names]
     weights = counts if any(c > 0 for c in counts) else None
     avg = fedavg(trees, weights)
+    opt_state = state.server_opt_state
+    tx = make_server_optimizer(
+        state.config.server_optimizer,
+        state.config.server_lr,
+        state.config.server_momentum,
+    )
+    if tx is not None and "params" in avg:
+        current = tree_from_bytes(state.global_blob)
+        if opt_state is None:
+            opt_state = tx.init(current["params"])
+        new_params, opt_state = apply_server_opt(
+            current["params"], avg["params"], tx, opt_state
+        )
+        avg = dict(avg)
+        avg["params"] = new_params  # BN stats keep the plain average
     new_blob = tree_to_bytes(avg)
     new_round = state.current_round + 1
     finished = new_round > state.config.max_rounds
@@ -240,6 +263,7 @@ def _aggregate(state: ServerState, now: float) -> ServerState:
         round_started_at=now,
         phase=PHASE_FINISHED if finished else PHASE_RUNNING,
         history=state.history + (entry,),
+        server_opt_state=opt_state,
     )
 
 

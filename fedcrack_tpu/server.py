@@ -31,6 +31,14 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
     p.add_argument("--registration-window", type=float, dest="registration_window_s")
     p.add_argument("--round-deadline", type=float, dest="round_deadline_s")
     p.add_argument("--fedprox-mu", type=float, dest="fedprox_mu")
+    p.add_argument(
+        "--server-optimizer",
+        dest="server_optimizer",
+        help="FedOpt server update: avg (plain FedAvg), momentum/fedavgm, "
+        "adam/fedadam",
+    )
+    p.add_argument("--server-lr", type=float, dest="server_lr")
+    p.add_argument("--server-momentum", type=float, dest="server_momentum")
     p.add_argument("--seed", type=int, help="PRNG seed for the initial global model")
     p.add_argument(
         "--ckpt-dir",
@@ -81,6 +89,9 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         ("registration_window_s", "registration_window_s"),
         ("round_deadline_s", "round_deadline_s"),
         ("fedprox_mu", "fedprox_mu"),
+        ("server_optimizer", "server_optimizer"),
+        ("server_lr", "server_lr"),
+        ("server_momentum", "server_momentum"),
         ("ckpt_dir", "ckpt_dir"),
         ("seed", "seed"),
         ("metrics_path", "metrics_path"),

@@ -234,3 +234,86 @@ def test_log_chunk_offsets_idempotent_and_gap_rejected():
     state = R.drop_log(state, "c", "t")
     assert "c/t" not in state.logs
     assert R.drop_log(state, "c", "t").logs == state.logs
+
+
+class TestFedOpt:
+    """Server-side optimizers on the round pseudo-gradient (FedOpt)."""
+
+    def _vars(self, value):
+        return {
+            "params": {"w": np.full(3, value, np.float32)},
+            "batch_stats": {"bn": {"mean": np.full(3, value, np.float32)}},
+        }
+
+    def _session(self, cfg, uploads_per_round):
+        """Drive the pure machine: 1-client cohort, given per-round uploads."""
+        from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
+
+        state = R.initial_state(cfg, self._vars(0.0))
+        state, _ = R.transition(state, R.Ready(cname="a", now=0.0))
+        state, _ = R.transition(
+            state, R.Tick(now=cfg.registration_window_s + 1.0)
+        )
+        blobs = []
+        for rnd, up in enumerate(uploads_per_round, start=1):
+            state, rep = R.transition(
+                state,
+                R.TrainDone(
+                    cname="a",
+                    round=rnd,
+                    blob=tree_to_bytes(self._vars(up)),
+                    num_samples=4,
+                    now=float(rnd),
+                ),
+            )
+            blobs.append(tree_from_bytes(state.global_blob))
+        return state, blobs
+
+    def _cfg(self, **kw):
+        from fedcrack_tpu.configs import FedConfig
+
+        return FedConfig(
+            cohort_size=1, max_rounds=3, registration_window_s=1.0, **kw
+        )
+
+    def test_avg_default_is_plain_fedavg(self):
+        _, blobs = self._session(self._cfg(), [5.0, 7.0])
+        np.testing.assert_allclose(blobs[0]["params"]["w"], 5.0)
+        np.testing.assert_allclose(blobs[1]["params"]["w"], 7.0)
+
+    def test_momentum_zero_lr_one_recovers_fedavg(self):
+        cfg = self._cfg(
+            server_optimizer="momentum", server_lr=1.0, server_momentum=0.0
+        )
+        _, blobs = self._session(cfg, [5.0, 7.0])
+        np.testing.assert_allclose(blobs[0]["params"]["w"], 5.0, rtol=1e-6)
+        np.testing.assert_allclose(blobs[1]["params"]["w"], 7.0, rtol=1e-6)
+
+    def test_fedavgm_closed_form(self):
+        """optax.sgd trace: m_t = g_t + beta*m_{t-1}, x_t = x_{t-1} - lr*m_t
+        with pseudo-gradient g_t = x_{t-1} - avg_t."""
+        beta, lr = 0.9, 1.0
+        cfg = self._cfg(
+            server_optimizer="fedavgm", server_lr=lr, server_momentum=beta
+        )
+        _, blobs = self._session(cfg, [5.0, 5.0])
+        # round 1: x0=0, g1 = 0-5 = -5, m1 = -5, x1 = 0 - (-5) = 5
+        np.testing.assert_allclose(blobs[0]["params"]["w"], 5.0, rtol=1e-6)
+        # round 2: g2 = 5-5 = 0, m2 = 0 + 0.9*(-5) = -4.5, x2 = 5 + 4.5 = 9.5
+        np.testing.assert_allclose(blobs[1]["params"]["w"], 9.5, rtol=1e-6)
+        # BN stats NEVER go through the optimizer: plain average each round
+        np.testing.assert_allclose(blobs[1]["batch_stats"]["bn"]["mean"], 5.0)
+
+    def test_fedadam_moves_toward_average(self):
+        cfg = self._cfg(server_optimizer="fedadam", server_lr=0.1)
+        state, blobs = self._session(cfg, [5.0, 5.0])
+        w1 = blobs[0]["params"]["w"]
+        w2 = blobs[1]["params"]["w"]
+        assert np.all(w1 > 0) and np.all(w2 > w1) and np.all(w2 <= 5.01)
+        assert state.server_opt_state is not None
+
+    def test_unknown_kind_rejected(self):
+        from fedcrack_tpu.fed.algorithms import make_server_optimizer
+
+        with pytest.raises(ValueError, match="unknown server optimizer"):
+            make_server_optimizer("adagrad")
