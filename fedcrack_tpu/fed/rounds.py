@@ -171,13 +171,20 @@ def initial_state(config: FedConfig, global_variables: Any) -> ServerState:
 
 
 def _ready_config(state: ServerState, status: str) -> dict[str, Any]:
-    """The handshake config map (reference keys, fl_server.py:69-75)."""
+    """The handshake config map (reference keys, fl_server.py:69-75), plus
+    the round's training hyperparameters — the server's algorithm choice
+    configures the cohort in-band instead of relying on every client being
+    launched with matching flags (the reference hardcoded epochs/batch
+    client-side and ignored the ctor args, SURVEY.md §2.2(4))."""
     return {
         "state": status,
         "model_version": state.model_version,
         "current_round": state.current_round,
         "max_train_round": state.config.max_rounds,
         "model_type": state.config.model_type,
+        "local_epochs": state.config.local_epochs,
+        "learning_rate": state.config.learning_rate,
+        "fedprox_mu": state.config.fedprox_mu,
     }
 
 

@@ -17,7 +17,12 @@ import jax
 
 from fedcrack_tpu.configs import FedConfig
 from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
-from fedcrack_tpu.train.local import TrainState, create_train_state, local_fit
+from fedcrack_tpu.train.local import (
+    TrainState,
+    create_train_state,
+    local_fit,
+    make_optimizer,
+)
 
 
 def reset_optimizer(state: TrainState) -> TrainState:
@@ -46,18 +51,29 @@ def make_train_fn(
         jax.random.key(seed), config.model, config.learning_rate
     )
     template = state.variables
-    holder = {"state": state}
+    holder = {"state": state, "learning_rate": config.learning_rate}
 
-    def train_fn(blob: bytes, rnd: int) -> tuple[bytes, int, dict[str, float]]:
+    def train_fn(
+        blob: bytes, rnd: int, hparams: dict | None = None
+    ) -> tuple[bytes, int, dict[str, float]]:
+        # The server's in-band hyperparameters (enroll handshake) override
+        # the client-side defaults — one coordinator configures the cohort.
+        hparams = hparams or {}
+        epochs = int(hparams.get("local_epochs", config.local_epochs))
+        mu = float(hparams.get("fedprox_mu", config.fedprox_mu))
+        lr = float(hparams.get("learning_rate", config.learning_rate))
         variables = tree_from_bytes(blob, template=template)
         st = holder["state"].replace_variables(variables)
+        if lr != holder["learning_rate"]:
+            st = st.replace(tx=make_optimizer(lr))
+            holder["learning_rate"] = lr
         st = reset_optimizer(st)
         with profiler_trace(config.profile_dir or None), stopwatch() as timer:
             st, metrics = local_fit(
                 st,
                 dataset,
-                epochs=config.local_epochs,
-                mu=config.fedprox_mu,
+                epochs=epochs,
+                mu=mu,
                 anchor_params=st.params,
             )
         holder["state"] = st

@@ -31,8 +31,11 @@ from fedcrack_tpu.transport.service import METHOD, SERVICE_NAME, channel_options
 
 log = logging.getLogger("fedcrack.client")
 
-# train_fn(weights_blob, round) -> (weights_blob, sample_count, metrics)
-TrainFn = Callable[[bytes, int], tuple[bytes, int, dict[str, float]]]
+# train_fn(weights_blob, round[, hparams]) -> (weights_blob, sample_count,
+# metrics). The optional third parameter receives the server's in-band
+# training hyperparameters from the enroll handshake (local_epochs,
+# learning_rate, fedprox_mu); two-parameter trainers are also accepted.
+TrainFn = Callable[..., tuple[bytes, int, dict[str, float]]]
 
 # The reference chunked file uploads at 100 MB (fl_client.py:36); 4 MiB keeps
 # each control message small while still amortizing the per-call overhead.
@@ -62,6 +65,16 @@ class FedClient:
     ):
         self.config = config
         self.train_fn = train_fn
+        import inspect
+
+        try:
+            n_params = len(inspect.signature(train_fn).parameters)
+        except (TypeError, ValueError):
+            n_params = 2
+        self._train_takes_hparams = n_params >= 3
+        # Server hyperparameters from the enroll handshake (set in
+        # run_session; exposed for callers/tests).
+        self.server_hparams: dict[str, Any] = {}
         # Files shipped to the server's log sink after the final round
         # (reference C2.1: the 'L' chunked uploader, fl_client.py:35-50 —
         # present there but its call site was commented out; enabled here).
@@ -134,6 +147,11 @@ class FedClient:
             current_round = int(cfg["current_round"])
             max_rounds = int(cfg["max_train_round"])
             model_version = int(cfg["model_version"])
+            self.server_hparams = {
+                k: cfg[k]
+                for k in ("local_epochs", "learning_rate", "fedprox_mu")
+                if k in cfg
+            }
 
             # Phase 2: pull global weights (reference 'P', fl_client.py:99-102)
             msg = self._msg()
@@ -147,7 +165,14 @@ class FedClient:
                 self._call(method, msg)
 
                 # Phase 4: local fit (reference: manage_train, §3.3)
-                weights, n_samples, metrics = self.train_fn(weights, current_round)
+                if self._train_takes_hparams:
+                    weights, n_samples, metrics = self.train_fn(
+                        weights, current_round, self.server_hparams
+                    )
+                else:
+                    weights, n_samples, metrics = self.train_fn(
+                        weights, current_round
+                    )
                 result.history.append({"round": current_round, **metrics})
 
                 # Phase 5: report (reference 'D', fl_client.py:124-127)

@@ -102,3 +102,34 @@ def test_centralized_trainer_checkpoints_best(tmp_path, fixture_data):
     got = jax.tree_util.tree_leaves(restored["params"])
     want = jax.tree_util.tree_leaves(jax.device_get(state.params))
     assert all(np.array_equal(g, w) for g, w in zip(got, want))
+
+
+def test_make_train_fn_honors_handshake_hparams():
+    """Server hparams override the client config: epochs shows up in the
+    jitted step count, and a changed lr rebuilds the optimizer."""
+    import numpy as np
+
+    from fedcrack_tpu.configs import DataConfig, FedConfig, ModelConfig
+    from fedcrack_tpu.data.pipeline import ArrayDataset
+    from fedcrack_tpu.data.synthetic import synth_crack_batch
+    from fedcrack_tpu.fed.serialization import tree_to_bytes
+    from fedcrack_tpu.train.federated import make_train_fn
+
+    cfg = FedConfig(
+        local_epochs=1,
+        model=ModelConfig(img_size=32),
+        data=DataConfig(img_size=32, batch_size=4),
+    )
+    images, masks = synth_crack_batch(8, img_size=32, seed=0)
+    dataset = ArrayDataset(images, masks, batch_size=4, seed=0)
+    train_fn, holder = make_train_fn(cfg, dataset, batch_size=4, seed=0)
+    blob = tree_to_bytes(holder["state"].variables)
+
+    train_fn(blob, 1, {"local_epochs": 3, "learning_rate": 0.01, "fedprox_mu": 0.0})
+    # 3 epochs x (8 samples / batch 4) = 6 jitted steps
+    assert int(holder["state"].step) == 6
+    assert holder["learning_rate"] == 0.01
+
+    # no hparams -> client defaults (1 epoch, 2 more steps)
+    train_fn(blob, 2)
+    assert int(holder["state"].step) == 8

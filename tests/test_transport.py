@@ -255,3 +255,34 @@ def test_server_side_eval_runs_per_round(session_cfg, tmp_path):
     assert server.eval_history[0]["round"] == 1
     assert server.eval_history[1]["model_version"] == 2
     assert all(e["loss"] == 0.5 for e in server.eval_history)
+
+
+def test_handshake_hyperparameters_reach_trainer(session_cfg):
+    """The server's local_epochs / learning_rate / fedprox_mu ride the
+    enroll handshake config map and are handed to the client's train_fn —
+    one coordinator configures the cohort (the reference hardcoded these
+    client-side, SURVEY.md §2.2(4))."""
+    cfg = dataclasses.replace(
+        session_cfg,
+        cohort_size=1,
+        max_rounds=1,
+        local_epochs=7,
+        learning_rate=0.005,
+        fedprox_mu=0.125,
+    )
+    seen = []
+
+    def train_fn(blob, rnd, hparams):
+        seen.append(dict(hparams))
+        return _fake_train(1.0, 10)(blob, rnd)
+
+    server = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
+    with ServerThread(server) as st:
+        # The CLIENT-side config deliberately disagrees with the server's.
+        client_cfg = dataclasses.replace(cfg, local_epochs=1, fedprox_mu=0.0)
+        result = FedClient(client_cfg, train_fn, cname="a", port=st.port).run_session()
+
+    assert result.rounds_completed == 1
+    assert seen == [
+        {"local_epochs": 7, "learning_rate": 0.005, "fedprox_mu": 0.125}
+    ]
