@@ -72,19 +72,32 @@ def main() -> None:
     stacked_images = jax.device_put(stacked_images, data_sharding)
     stacked_masks = jax.device_put(stacked_masks, data_sharding)
 
+    # Rounds are CHAINED (each consumes the previous round's output) and
+    # synced via a host readback of the round metrics, not just
+    # block_until_ready: through remote-device tunnels the latter has been
+    # observed to return before the program finishes, and repeating one
+    # identical call would let any result caching fake the timing. Chained
+    # rounds are also what a real federation runs. The loss depends on every
+    # step, so its readback is a full-program barrier.
+    mesh_vars = {"v": variables}
+
     def mesh_round():
-        new_vars, _ = round_fn(
-            variables, stacked_images, stacked_masks, active, n_samples
+        new_vars, metrics = round_fn(
+            mesh_vars["v"], stacked_images, stacked_masks, active, n_samples
         )
-        jax.block_until_ready(new_vars)
+        mesh_vars["v"] = new_vars
+        float(np.asarray(metrics["loss"])[0])
         return new_vars
 
     # ---- host plane: reference architecture (per-step dispatch + byte
     # shipping + host-side average), minus the actual TCP socket ----
+    # Chained across reps like the mesh plane; tree_to_bytes is a real
+    # device->host readback, so each round is fully synced.
     mu0 = np.float32(0.0)
+    host_vars = {"v": variables}
 
     def host_round():
-        blob = tree_to_bytes(variables)  # server -> client broadcast
+        blob = tree_to_bytes(host_vars["v"])  # server -> client broadcast
         uploads = []
         for c in range(n_clients):
             received = tree_from_bytes(blob, template=variables)
@@ -102,9 +115,14 @@ def main() -> None:
         trees = [tree_from_bytes(b, template=variables) for b in uploads]
         avg = fedavg(trees, weights=list(n_samples))
         jax.block_until_ready(avg)
+        host_vars["v"] = jax.device_get(avg)
         return avg
 
     # Warm up both programs (first TPU compile is slow and cached after).
+    # The mesh plane warms twice: the first call consumes the host pytree,
+    # the second compiles the committed-device-input signature the timed
+    # chained reps use.
+    mesh_round()
     mesh_round()
     host_round()
 
