@@ -32,6 +32,19 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--mask-dir")
     p.add_argument("--synthetic", type=int, default=0, help="use N generated samples")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--num-clients",
+        type=int,
+        default=None,
+        help="total cohort size for data sharding: each client takes a "
+        "disjoint shard of the train split (cfg.data.partition: iid or "
+        "crack-density skew — the reference gave every client the same "
+        "data). Defaults to the config's cohort_size when --client-index "
+        "is given.",
+    )
+    p.add_argument(
+        "--client-index", type=int, default=None, help="this client's shard row"
+    )
     p.add_argument("--predict-dir", help="write final-round mask predictions here")
     p.add_argument("--metrics", dest="metrics_path", help="JSONL metrics file")
     p.add_argument(
@@ -62,21 +75,53 @@ def main(argv: list[str] | None = None) -> int:
         cfg = dataclasses.replace(cfg, **overrides)
 
     batch = cfg.data.batch_size
+    if args.num_clients is not None:
+        num_clients = args.num_clients
+    elif args.client_index is not None:
+        num_clients = cfg.cohort_size  # the presets' cohort IS the shard count
+    else:
+        num_clients = 1
+    client_index = args.client_index if args.client_index is not None else 0
+    if num_clients == 1 and cfg.cohort_size > 1 and not args.synthetic:
+        logging.warning(
+            "data sharding is OFF (every client would train the same data, "
+            "like the reference): pass --client-index (and optionally "
+            "--num-clients) so each of the %d cohort members takes a "
+            "disjoint shard",
+            cfg.cohort_size,
+        )
+
+    def local_shard(pairs):
+        # Train side of the reference's seeded split
+        # (client_fit_model.py:76-82), then this client's disjoint shard:
+        # IID or crack-density skew (BASELINE.md config 4). Every client
+        # computes the same deterministic assignment and picks its row.
+        from fedcrack_tpu.data.sharding import shard_pairs
+
+        train_pairs, _ = reference_split(
+            pairs, cfg.data.train_samples, cfg.data.split_seed
+        )
+        return shard_pairs(
+            train_pairs,
+            num_clients,
+            client_index,
+            partition=cfg.data.partition,
+            alpha=cfg.data.skew_alpha,
+            seed=cfg.data.split_seed,
+        )
+
     try:
         dataset = dataset_from_source(
+            # Synthetic shards differ per client through the seed.
             args.synthetic,
             args.image_dir,
             args.mask_dir,
             img_size=cfg.model.img_size,
             batch_size=batch,
-            seed=args.seed,
+            seed=args.seed + client_index,
             num_workers=cfg.data.num_workers,
             prefetch=cfg.data.prefetch,
-            # Local shard = the reference's train side of the seeded split
-            # (client_fit_model.py:76-82).
-            pair_filter=lambda pairs: reference_split(
-                pairs, cfg.data.train_samples, cfg.data.split_seed
-            )[0],
+            pair_filter=local_shard,
         )
     except ValueError as e:
         p.error(str(e))

@@ -254,9 +254,16 @@ class FedServer:
         if self._server is not None:
             await self._server.stop(grace)
 
-    async def serve_until_finished(self, extra_grace_s: float = 5.0) -> R.ServerState:
+    async def serve_until_finished(
+        self, extra_grace_s: float | None = None
+    ) -> R.ServerState:
         """Run a full federation: serve until the round machine reaches FIN,
-        linger briefly so clients can pull the final weights, then stop."""
+        linger so every client can learn FIN and pull the final weights, then
+        stop. The default grace covers two client poll periods — a slower
+        client's next version poll must find the server alive, or it is
+        stranded retrying against a dead port."""
+        if extra_grace_s is None:
+            extra_grace_s = max(5.0, 2.0 * self.config.poll_period_s + 5.0)
         await self.start()
         await self.finished.wait()
         await asyncio.sleep(extra_grace_s)

@@ -170,3 +170,37 @@ def test_dataset_from_source_dirs_and_filter(tmp_path):
 
     with pytest.raises(ValueError, match="image-dir"):
         dataset_from_source(0, None, None, img_size=32, batch_size=4)
+
+
+def test_shard_pairs_disjoint_cover_iid_and_skew(tmp_path):
+    from fedcrack_tpu.data import list_pairs, write_synthetic_dataset
+    from fedcrack_tpu.data.sharding import shard_pairs
+
+    write_synthetic_dataset(str(tmp_path), 12, img_size=32)
+    pairs = list_pairs(str(tmp_path / "images"), str(tmp_path / "masks"))
+
+    for kind in ("iid", "skew"):
+        shards = [shard_pairs(pairs, 3, i, partition=kind, seed=7) for i in range(3)]
+        flat = [p for s in shards for p in s]
+        assert sorted(flat) == sorted(pairs), kind  # disjoint + cover
+        # deterministic: every process computes the same assignment
+        again = shard_pairs(pairs, 3, 1, partition=kind, seed=7)
+        assert again == shards[1], kind
+
+    assert shard_pairs(pairs, 1, 0) == list(pairs)
+    with pytest.raises(ValueError, match="out of range"):
+        shard_pairs(pairs, 3, 3)
+    with pytest.raises(ValueError, match="unknown partition"):
+        shard_pairs(pairs, 3, 0, partition="sorted")
+
+
+def test_partition_skew_no_empty_shards():
+    from fedcrack_tpu.data.sharding import partition_skew
+
+    # Small dataset vs many clients: Dirichlet draws can zero out a client's
+    # floor counts — the rebalance must leave every shard non-empty.
+    for seed in range(6):
+        shards = partition_skew(np.linspace(0, 1, 24), 8, alpha=0.1, seed=seed)
+        assert all(len(s) > 0 for s in shards), seed
+        flat = np.concatenate(shards)
+        assert sorted(flat.tolist()) == list(range(24)), seed
