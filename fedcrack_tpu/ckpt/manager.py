@@ -137,7 +137,6 @@ def restore_server_state(
     Returns ``None`` when the directory holds no checkpoint.
     """
     from fedcrack_tpu.fed import rounds as R
-    from fedcrack_tpu.fed.serialization import tree_to_bytes
 
     ckpt = ckptr.restore(template)
     if ckpt is None:
@@ -146,9 +145,10 @@ def restore_server_state(
         phase = R.PHASE_FINISHED
     else:
         phase = R.PHASE_ENROLL
-    return R.ServerState(
-        config=config,
-        global_blob=tree_to_bytes(ckpt.variables),
+    # Route through initial_state so dtype-dependent derived fields (the
+    # float32 decode template, the wire-dtype broadcast copy) are rebuilt
+    # consistently with a fresh boot.
+    return R.initial_state(config, ckpt.variables)._replace(
         phase=phase,
         current_round=ckpt.current_round,
         model_version=ckpt.model_version,

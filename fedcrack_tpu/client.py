@@ -81,6 +81,10 @@ def main(argv: list[str] | None = None) -> int:
         num_clients = cfg.cohort_size  # the presets' cohort IS the shard count
     else:
         num_clients = 1
+    if num_clients > 1 and args.client_index is None:
+        # Defaulting to shard 0 here would pin EVERY client to the same
+        # shard and silently leave the rest of the data untrained.
+        p.error("--num-clients > 1 requires --client-index")
     client_index = args.client_index if args.client_index is not None else 0
     if num_clients == 1 and cfg.cohort_size > 1 and not args.synthetic:
         logging.warning(

@@ -95,6 +95,10 @@ class FedConfig:
     # "mobilenet_v2" (fl_server.py:75) while actually sharing the U-Net; we
     # advertise honestly but accept the legacy alias (SURVEY.md §2.2(3)).
     model_type: str = "resunet"
+    # Wire dtype for weight payloads on the control plane: "bfloat16" halves
+    # upload + broadcast bytes (server math stays float32; the reference
+    # shipped full float32 pickles, fl_client.py:63).
+    wire_dtype: str = "float32"
     host: str = "127.0.0.1"
     port: int = 8889              # reference: fl_server.py:218
     # Orbax checkpoint directory; empty disables. When the directory already
@@ -127,6 +131,10 @@ class FedConfig:
             raise ValueError(
                 "data.img_size and model.img_size must match; got "
                 f"{self.data.img_size} vs {self.model.img_size}"
+            )
+        if self.wire_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"wire_dtype must be float32 or bfloat16, got {self.wire_dtype!r}"
             )
 
     # ---- serialization (in-band config map + files) ----

@@ -31,6 +31,8 @@ import dataclasses
 from dataclasses import dataclass
 from typing import Any, Mapping
 
+import jax
+
 from fedcrack_tpu.configs import FedConfig
 from fedcrack_tpu.fed.algorithms import (
     apply_server_opt,
@@ -147,6 +149,18 @@ class ServerState:
     # FedOpt server-optimizer state (momentum/Adam moments); None for plain
     # FedAvg. Lazily initialized on the first aggregation.
     server_opt_state: Any = None
+    # Float32 pytree template for decoding client uploads: keeps server math
+    # full precision regardless of the wire dtype. Set by initial_state.
+    template: Any = None
+    # The blob actually broadcast to clients: equals global_blob for a
+    # float32 wire, or its bfloat16-cast re-encoding (half the bytes) when
+    # config.wire_dtype == "bfloat16". Server-side consumers (eval,
+    # checkpoints) always read global_blob.
+    wire_blob: bytes = b""
+
+    @property
+    def broadcast_blob(self) -> bytes:
+        return self.wire_blob or self.global_blob
 
     def _replace(self, **kw) -> "ServerState":
         return dataclasses.replace(self, **kw)
@@ -163,11 +177,22 @@ def drop_log(state: ServerState, cname: str, title: str) -> ServerState:
     return state._replace(logs=logs)
 
 
+def _wire_cast(config: FedConfig) -> str | None:
+    return "bfloat16" if config.wire_dtype == "bfloat16" else None
+
+
 def initial_state(config: FedConfig, global_variables: Any) -> ServerState:
     """Server boot: build + serialize the initial global model
     (reference: fl_server.py:229-231 builds it via the missing
     model_evaluate module; SURVEY.md §2.5)."""
-    return ServerState(config=config, global_blob=tree_to_bytes(global_variables))
+    cast = _wire_cast(config)
+    blob = tree_to_bytes(global_variables)
+    return ServerState(
+        config=config,
+        global_blob=blob,
+        template=jax.device_get(global_variables),
+        wire_blob=tree_to_bytes(global_variables, cast_dtype=cast) if cast else b"",
+    )
 
 
 def _ready_config(state: ServerState, status: str) -> dict[str, Any]:
@@ -185,6 +210,7 @@ def _ready_config(state: ServerState, status: str) -> dict[str, Any]:
         "local_epochs": state.config.local_epochs,
         "learning_rate": state.config.learning_rate,
         "fedprox_mu": state.config.fedprox_mu,
+        "wire_dtype": state.config.wire_dtype,
     }
 
 
@@ -227,7 +253,12 @@ def _aggregate(state: ServerState, now: float) -> ServerState:
     """FedAvg (optionally + FedOpt server step) over the round's received
     updates; advance round/version."""
     names = sorted(state.received.keys())
-    trees = [tree_from_bytes(state.received[n][0]) for n in names]
+    # Decode against the float32 template so server math keeps full
+    # precision even when the wire carries bfloat16 payloads.
+    trees = [
+        tree_from_bytes(state.received[n][0], template=state.template)
+        for n in names
+    ]
     counts = [state.received[n][1] for n in names]
     weights = counts if any(c > 0 for c in counts) else None
     avg = fedavg(trees, weights)
@@ -238,7 +269,7 @@ def _aggregate(state: ServerState, now: float) -> ServerState:
         state.config.server_momentum,
     )
     if tx is not None and "params" in avg:
-        current = tree_from_bytes(state.global_blob)
+        current = tree_from_bytes(state.global_blob, template=state.template)
         if opt_state is None:
             opt_state = tx.init(current["params"])
         new_params, opt_state = apply_server_opt(
@@ -247,6 +278,8 @@ def _aggregate(state: ServerState, now: float) -> ServerState:
         avg = dict(avg)
         avg["params"] = new_params  # BN stats keep the plain average
     new_blob = tree_to_bytes(avg)
+    cast = _wire_cast(state.config)
+    new_wire_blob = tree_to_bytes(avg, cast_dtype=cast) if cast else b""
     new_round = state.current_round + 1
     finished = new_round > state.config.max_rounds
     entry = {
@@ -260,10 +293,11 @@ def _aggregate(state: ServerState, now: float) -> ServerState:
             now - state.round_started_at if state.round_started_at is not None else None
         ),
         "bytes_received": sum(len(state.received[n][0]) for n in names),
-        "bytes_broadcast": len(new_blob),
+        "bytes_broadcast": len(new_wire_blob or new_blob),
     }
     return state._replace(
         global_blob=new_blob,
+        wire_blob=new_wire_blob,
         current_round=new_round,
         model_version=state.model_version + 1,
         received={},
@@ -302,7 +336,7 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
         case PullWeights():
             # Broadcasts the CURRENT global weights — after round R these are
             # the round-R average (fix #1; the reference resent init weights).
-            return state, Reply(status="OK", blob=state.global_blob, title="parameters")
+            return state, Reply(status="OK", blob=state.broadcast_blob, title="parameters")
 
         case TrainingNotice():
             return state, Reply(status="OK", title="T")
@@ -326,7 +360,7 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
             if state.phase == PHASE_FINISHED:
                 return state, Reply(
                     status=FIN,
-                    blob=state.global_blob,
+                    blob=state.broadcast_blob,
                     config=_ready_config(state, FIN),
                 )
             if cname not in state.cohort:
@@ -354,7 +388,7 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
                 status = FIN if state.phase == PHASE_FINISHED else RESP_ARY
                 return state, Reply(
                     status=status,
-                    blob=state.global_blob,
+                    blob=state.broadcast_blob,
                     config=_ready_config(state, status),
                 )
             return state, Reply(status=RESP_ACY, config=_ready_config(state, RESP_ACY))
@@ -365,13 +399,13 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
                 # session holding only their own local weights
                 return state, Reply(
                     status=FIN,
-                    blob=state.global_blob,
+                    blob=state.broadcast_blob,
                     config=_ready_config(state, FIN),
                 )
             if state.model_version > mv:
                 return state, Reply(
                     status=NOT_WAIT,
-                    blob=state.global_blob,
+                    blob=state.broadcast_blob,
                     config=_ready_config(state, NOT_WAIT),
                 )
             return state, Reply(status=WAIT, config=_ready_config(state, WAIT))

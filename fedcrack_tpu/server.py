@@ -39,6 +39,12 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
     )
     p.add_argument("--server-lr", type=float, dest="server_lr")
     p.add_argument("--server-momentum", type=float, dest="server_momentum")
+    p.add_argument(
+        "--wire-dtype",
+        dest="wire_dtype",
+        help="weight payload dtype on the control plane: float32 or "
+        "bfloat16 (halves upload+broadcast bytes; server math stays f32)",
+    )
     p.add_argument("--seed", type=int, help="PRNG seed for the initial global model")
     p.add_argument(
         "--ckpt-dir",
@@ -92,6 +98,7 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         ("server_optimizer", "server_optimizer"),
         ("server_lr", "server_lr"),
         ("server_momentum", "server_momentum"),
+        ("wire_dtype", "wire_dtype"),
         ("ckpt_dir", "ckpt_dir"),
         ("seed", "seed"),
         ("metrics_path", "metrics_path"),

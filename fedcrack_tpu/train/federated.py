@@ -62,6 +62,7 @@ def make_train_fn(
         epochs = int(hparams.get("local_epochs", config.local_epochs))
         mu = float(hparams.get("fedprox_mu", config.fedprox_mu))
         lr = float(hparams.get("learning_rate", config.learning_rate))
+        wire_dtype = str(hparams.get("wire_dtype", config.wire_dtype))
         variables = tree_from_bytes(blob, template=template)
         st = holder["state"].replace_variables(variables)
         if lr != holder["learning_rate"]:
@@ -78,7 +79,10 @@ def make_train_fn(
             )
         holder["state"] = st
         n_samples = int(metrics.pop("num_steps", 0) * batch_size)
-        out_blob = tree_to_bytes(st.variables)
+        out_blob = tree_to_bytes(
+            st.variables,
+            cast_dtype="bfloat16" if wire_dtype == "bfloat16" else None,
+        )
         if metrics_logger is not None:
             metrics_logger.log(
                 "local_fit",

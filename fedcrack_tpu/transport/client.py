@@ -149,7 +149,12 @@ class FedClient:
             model_version = int(cfg["model_version"])
             self.server_hparams = {
                 k: cfg[k]
-                for k in ("local_epochs", "learning_rate", "fedprox_mu")
+                for k in (
+                    "local_epochs",
+                    "learning_rate",
+                    "fedprox_mu",
+                    "wire_dtype",
+                )
                 if k in cfg
             }
 

@@ -317,3 +317,44 @@ class TestFedOpt:
 
         with pytest.raises(ValueError, match="unknown server optimizer"):
             make_server_optimizer("adagrad")
+
+
+class TestBf16Wire:
+    def _state(self):
+        cfg = dataclasses.replace(CFG, wire_dtype="bfloat16", cohort_size=2)
+        return R.initial_state(cfg, _tree(42))
+
+    def test_broadcast_blob_is_half_size_and_handshake_advertises(self):
+        state = self._state()
+        assert 0 < len(state.broadcast_blob) < 0.75 * len(state.global_blob)
+        state, r = R.transition(state, R.Ready("a", now=0.0))
+        assert r.config["wire_dtype"] == "bfloat16"
+
+    def test_round_math_stays_f32_and_broadcast_matches_average(self):
+        state = self._state()
+        state = enroll_two(state)
+        # client uploads arrive bf16-cast (as the handshake instructs)
+        blob_a = tree_to_bytes(_tree(1), cast_dtype="bfloat16")
+        blob_b = tree_to_bytes(_tree(2), cast_dtype="bfloat16")
+        state, _ = R.transition(
+            state, R.TrainDone("a", round=1, blob=blob_a, num_samples=8, now=2.0)
+        )
+        state, rb = R.transition(
+            state, R.TrainDone("b", round=1, blob=blob_b, num_samples=8, now=3.0)
+        )
+        # internal global stays float32 at full precision of the decoded
+        # (bf16-rounded) uploads
+        internal = tree_from_bytes(state.global_blob)
+        assert internal["bias"].dtype == np.float32
+        expect = np.mean([_tree(1)["bias"], _tree(2)["bias"]], axis=0)
+        np.testing.assert_allclose(internal["bias"], expect, atol=0.05)
+        # the reply blob is the bf16 wire copy, decodable via a template
+        got = tree_from_bytes(rb.blob, template=_tree(0))
+        np.testing.assert_allclose(got["bias"], expect, atol=0.05)
+        assert len(rb.blob) < 0.75 * len(state.global_blob)
+        # observability reflects the wire size actually broadcast
+        assert state.history[-1]["bytes_broadcast"] == len(rb.blob)
+
+    def test_rejects_unknown_wire_dtype(self):
+        with pytest.raises(ValueError, match="wire_dtype"):
+            dataclasses.replace(CFG, wire_dtype="float16")

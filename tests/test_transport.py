@@ -284,5 +284,10 @@ def test_handshake_hyperparameters_reach_trainer(session_cfg):
 
     assert result.rounds_completed == 1
     assert seen == [
-        {"local_epochs": 7, "learning_rate": 0.005, "fedprox_mu": 0.125}
+        {
+            "local_epochs": 7,
+            "learning_rate": 0.005,
+            "fedprox_mu": 0.125,
+            "wire_dtype": "float32",
+        }
     ]
