@@ -26,6 +26,8 @@ class FedCheckpoint:
     history: tuple[dict, ...] = ()
     # Client-uploaded log chunks (rounds.py LogChunk sink): title -> bytes.
     logs: Mapping[str, bytes] = dataclasses.field(default_factory=dict)
+    # FedOpt server-optimizer moments (None for plain FedAvg).
+    server_opt_state: Any = None
 
 
 class FedCheckpointer:
@@ -55,13 +57,13 @@ class FedCheckpointer:
                 k: base64.b64encode(v).decode("ascii") for k, v in ckpt.logs.items()
             },
         }
-        self._mngr.save(
-            ckpt.model_version,
-            args=ocp.args.Composite(
-                variables=ocp.args.StandardSave(ckpt.variables),
-                meta=ocp.args.JsonSave(meta),
-            ),
-        )
+        items = {
+            "variables": ocp.args.StandardSave(ckpt.variables),
+            "meta": ocp.args.JsonSave(meta),
+        }
+        if ckpt.server_opt_state is not None:
+            items["opt_state"] = ocp.args.StandardSave(ckpt.server_opt_state)
+        self._mngr.save(ckpt.model_version, args=ocp.args.Composite(**items))
         self._mngr.wait_until_finished()
 
     def latest_version(self) -> int | None:
@@ -96,6 +98,24 @@ class FedCheckpointer:
             },
         )
 
+    def restore_opt_state(self, opt_template: Any) -> Any | None:
+        """Restore the FedOpt server-optimizer moments of the latest step
+        into ``opt_template``'s structure (``tx.init(params)``); None when
+        the step predates FedOpt or plain FedAvg was running."""
+        step = self._mngr.latest_step()
+        if step is None:
+            return None
+        try:
+            restored = self._mngr.restore(
+                step,
+                args=ocp.args.Composite(
+                    opt_state=ocp.args.StandardRestore(opt_template)
+                ),
+            )
+        except (KeyError, ValueError, FileNotFoundError):
+            return None
+        return restored["opt_state"]
+
     def close(self) -> None:
         self._mngr.close()
 
@@ -120,6 +140,7 @@ def save_server_state(ckptr: FedCheckpointer, state: Any) -> None:
             variables=tree_from_bytes(state.global_blob),
             history=state.history,
             logs=state.logs,
+            server_opt_state=state.server_opt_state,
         )
     )
 
@@ -145,6 +166,16 @@ def restore_server_state(
         phase = R.PHASE_FINISHED
     else:
         phase = R.PHASE_ENROLL
+    # FedOpt moments resume too — otherwise a restarted FedAvgM/FedAdam
+    # coordinator would silently restart its momentum from zero.
+    from fedcrack_tpu.fed.algorithms import make_server_optimizer
+
+    opt_state = None
+    tx = make_server_optimizer(
+        config.server_optimizer, config.server_lr, config.server_momentum
+    )
+    if tx is not None:
+        opt_state = ckptr.restore_opt_state(tx.init(ckpt.variables["params"]))
     # Route through initial_state so dtype-dependent derived fields (the
     # float32 decode template, the wire-dtype broadcast copy) are rebuilt
     # consistently with a fresh boot.
@@ -154,4 +185,5 @@ def restore_server_state(
         model_version=ckpt.model_version,
         history=ckpt.history,
         logs=ckpt.logs,
+        server_opt_state=opt_state,
     )

@@ -189,3 +189,53 @@ def test_restore_without_template_gives_host_arrays(tmp_path):
     leaves = jax.tree_util.tree_leaves(restored.variables)
     assert leaves, "restored tree is empty"
     assert_trees_equal(restored.variables, variables)
+
+
+def test_fedopt_moments_survive_restart(tmp_path):
+    """A restarted FedAvgM coordinator resumes its momentum instead of
+    silently restarting it from zero."""
+    import dataclasses
+
+    from fedcrack_tpu.ckpt import FedCheckpointer, restore_server_state, save_server_state
+    from fedcrack_tpu.configs import FedConfig
+    from fedcrack_tpu.fed import rounds as R
+    from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
+
+    cfg = FedConfig(
+        cohort_size=1,
+        max_rounds=4,
+        registration_window_s=1.0,
+        server_optimizer="fedavgm",
+        server_lr=1.0,
+        server_momentum=0.9,
+    )
+    tree = lambda v: {"params": {"w": np.full(3, float(v), np.float32)}}
+
+    def drive(state, uploads, t0=0.0):
+        state, _ = R.transition(state, R.Ready("a", now=t0))
+        state, _ = R.transition(state, R.Tick(now=t0 + 2.0))
+        for rnd, up in uploads:
+            state, _ = R.transition(
+                state,
+                R.TrainDone("a", round=rnd, blob=tree_to_bytes(tree(up)),
+                            num_samples=4, now=t0 + rnd),
+            )
+        return state
+
+    # Uninterrupted run: rounds 1 and 2.
+    s_full = drive(R.initial_state(cfg, tree(0.0)), [(1, 5.0), (2, 5.0)])
+    want = tree_from_bytes(s_full.global_blob)["params"]["w"]
+
+    # Interrupted run: round 1, checkpoint, restart, round 2.
+    s1 = drive(R.initial_state(cfg, tree(0.0)), [(1, 5.0)])
+    with FedCheckpointer(tmp_path) as ck:
+        save_server_state(ck, s1)
+        resumed = restore_server_state(ck, cfg, tree(0.0))
+    assert resumed is not None and resumed.server_opt_state is not None
+    s2 = drive(resumed, [(2, 5.0)], t0=100.0)
+    got = tree_from_bytes(s2.global_blob)["params"]["w"]
+
+    # FedAvgM closed form: x2 = 9.5 (momentum carries round 1's pseudo-grad);
+    # without resumed moments the restart would give x2 = 5.0.
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    np.testing.assert_allclose(got, 9.5, rtol=1e-6)
