@@ -12,6 +12,7 @@ over the ``batch`` axis (SURVEY.md §5.8, §7 step 5).
 from fedcrack_tpu.parallel.mesh import make_mesh  # noqa: F401
 from fedcrack_tpu.parallel.fedavg_mesh import (  # noqa: F401
     build_federated_round,
+    build_spatial_federated_round,
     mesh_fedavg,
     stack_client_data,
 )

@@ -53,43 +53,44 @@ def _masked_mean_over_clients(tree: Any, weight: jax.Array, denom: jax.Array) ->
     return jax.tree_util.tree_map(leaf, tree)
 
 
-def build_federated_round(
+def _require_axes(mesh: Mesh, *axes: str) -> None:
+    missing = [a for a in axes if a not in mesh.shape]
+    if missing:
+        raise ValueError(
+            f"mesh has axes {mesh.axis_names}, but this round builder needs "
+            f"{axes} (missing {missing})"
+        )
+
+
+def _build_round(
     mesh: Mesh,
-    model_config: ModelConfig | None = None,
-    learning_rate: float = 1e-3,
-    local_epochs: int = 1,
-    fedprox_mu: float = 0.0,
+    model_config: ModelConfig,
+    learning_rate: float,
+    local_epochs: int,
+    fedprox_mu: float,
+    *,
+    inner_axis: str,
+    apply_fn,
+    image_spec: P,
+    validate_data,
 ):
-    """Compile-once round function.
+    """Shared core of the one-program federated round.
 
-    Returns ``round_fn(variables, images, masks, active, n_samples)``:
-
-    - ``variables``: the global ``{'params', 'batch_stats'}`` pytree
-      (replicated over the mesh);
-    - ``images``  float32 ``[C, steps, B, H, W, 3]``,
-      ``masks``   float32 ``[C, steps, B, H, W, 1]`` — per-client local data,
-      ``C == mesh.shape['clients']``; the per-step batch ``B`` is split over
-      the ``batch`` axis (must divide evenly);
-    - ``active``  float32 ``[C]`` participation mask (1 = reported, 0 =
-      dropped out mid-round);
-    - ``n_samples`` float32 ``[C]`` per-client sample counts (FedAvg
-      weights).
-
-    Returns ``(new_variables, per_client_metrics)`` where metrics leaves are
-    ``[C]`` arrays from each client's final local epoch. Adam state is fresh
-    each round (the reference rebuilds its model per round,
-    client_fit_model.py:155-157; here only the optimizer moments reset).
+    Both public builders are this skeleton with a different intra-client
+    sharding: ``apply_fn(params, batch_stats, images) -> (logits,
+    new_batch_stats)`` is the train-mode forward (plain sync-BN-over-batch
+    model, or the halo-exchange spatial forward), ``inner_axis`` is the mesh
+    axis the client's work is split over (``batch`` or ``space``), and
+    ``image_spec`` shards the data accordingly.
     """
-    model_config = model_config or ModelConfig()
-    model = ResUNet(config=model_config, bn_axis_name=BATCH)
     tx = make_optimizer(learning_rate)
     mu = float(fedprox_mu)
     n_client_shards = mesh.shape[CLIENTS]
-    n_batch_shards = mesh.shape[BATCH]
+    n_inner = mesh.shape[inner_axis]
 
     def client_fit(variables, images, masks, active, n_samples):
         # Per-shard blocks: leading clients-axis block is exactly one client.
-        images, masks = images[0], masks[0]          # [steps, B_local, H, W, ch]
+        images, masks = images[0], masks[0]
         active_i, n_i = active[0], n_samples[0]
         params = variables["params"]
         batch_stats = variables["batch_stats"]
@@ -102,36 +103,32 @@ def build_federated_round(
             imgs, msks = batch
 
             def loss_fn(p):
-                logits, mutated = model.apply(
-                    {"params": p, "batch_stats": batch_stats},
-                    imgs,
-                    train=True,
-                    mutable=["batch_stats"],
-                )
+                logits, new_stats = apply_fn(p, batch_stats, imgs)
                 # One fused pass for BCE + all statistics (Pallas kernel on
                 # TPU, XLA reference elsewhere — ops/pallas_bce.py).
                 m = fused_segmentation_metrics(logits, msks)
                 prox = fedprox_penalty(p, anchor, mu_arr)
-                return m["loss"] + prox, (m, mutated["batch_stats"])
+                return m["loss"] + prox, (m, new_stats)
 
             (loss, (m, new_stats)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params)
-            # Intra-client data parallelism: `params` is unvarying over the
-            # `batch` axis, so shard_map's AD already psums the per-shard
-            # cotangents; dividing by the shard count turns that sum of
-            # local-mean gradients into the gradient of the client's
-            # full-batch mean loss (a pmean here would be an identity on the
-            # already-summed value and double-count by the shard count).
-            grads = jax.tree_util.tree_map(lambda g: g / n_batch_shards, grads)
-            new_stats = lax.pmean(new_stats, BATCH)
+            # `params` is unvarying over the inner axis, so shard_map's AD
+            # already psums the per-shard cotangents; dividing by the shard
+            # count turns that sum of local-mean gradients into the gradient
+            # of the client's full mean loss (a pmean here would be an
+            # identity on the already-summed value and double-count).
+            grads = jax.tree_util.tree_map(lambda g: g / n_inner, grads)
+            # BN moments are already pmean-synced inside the forward; this
+            # keeps the carried stats bitwise identical across inner shards.
+            new_stats = lax.pmean(new_stats, inner_axis)
             updates, new_opt_state = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             metrics = {
-                "loss": lax.pmean(loss, BATCH),
-                "pixel_acc": lax.pmean(m["pixel_acc"], BATCH),
-                "iou_inter": lax.psum(m["iou_inter"], BATCH),
-                "iou_union": lax.psum(m["iou_union"], BATCH),
+                "loss": lax.pmean(loss, inner_axis),
+                "pixel_acc": lax.pmean(m["pixel_acc"], inner_axis),
+                "iou_inter": lax.psum(m["iou_inter"], inner_axis),
+                "iou_union": lax.psum(m["iou_union"], inner_axis),
             }
             return (new_params, new_stats, new_opt_state), metrics
 
@@ -179,16 +176,9 @@ def build_federated_round(
     sharded = jax.shard_map(
         client_fit,
         mesh=mesh,
-        in_specs=(
-            P(),                            # variables: replicated
-            P(CLIENTS, None, BATCH),        # images [C, steps, B, H, W, 3]
-            P(CLIENTS, None, BATCH),        # masks  [C, steps, B, H, W, 1]
-            P(CLIENTS),                     # active [C]
-            P(CLIENTS),                     # n_samples [C]
-        ),
+        in_specs=(P(), image_spec, image_spec, P(CLIENTS), P(CLIENTS)),
         out_specs=(P(), P(CLIENTS)),
     )
-
     jitted = jax.jit(sharded)
 
     def round_fn(variables, images, masks, active, n_samples):
@@ -197,6 +187,7 @@ def build_federated_round(
                 f"data carries {images.shape[0]} clients, mesh has "
                 f"{n_client_shards} on the '{CLIENTS}' axis"
             )
+        validate_data(images)
         active = np.asarray(active, np.float32)
         n_samples = np.asarray(n_samples, np.float32)
         # Same contract as fed.algorithms.fedavg: an empty effective cohort
@@ -209,6 +200,109 @@ def build_federated_round(
         return jitted(variables, images, masks, active, n_samples)
 
     return round_fn
+
+
+def build_federated_round(
+    mesh: Mesh,
+    model_config: ModelConfig | None = None,
+    learning_rate: float = 1e-3,
+    local_epochs: int = 1,
+    fedprox_mu: float = 0.0,
+):
+    """Compile-once round function over ``Mesh(('clients', 'batch'))``.
+
+    Returns ``round_fn(variables, images, masks, active, n_samples)``:
+
+    - ``variables``: the global ``{'params', 'batch_stats'}`` pytree
+      (replicated over the mesh);
+    - ``images``  float32 ``[C, steps, B, H, W, 3]``,
+      ``masks``   float32 ``[C, steps, B, H, W, 1]`` — per-client local data,
+      ``C == mesh.shape['clients']``; the per-step batch ``B`` is split over
+      the ``batch`` axis (must divide evenly);
+    - ``active``  float32 ``[C]`` participation mask (1 = reported, 0 =
+      dropped out mid-round);
+    - ``n_samples`` float32 ``[C]`` per-client sample counts (FedAvg
+      weights).
+
+    Returns ``(new_variables, per_client_metrics)`` where metrics leaves are
+    ``[C]`` arrays from each client's final local epoch. Adam state is fresh
+    each round (the reference rebuilds its model per round,
+    client_fit_model.py:155-157; here only the optimizer moments reset).
+    """
+    model_config = model_config or ModelConfig()
+    _require_axes(mesh, CLIENTS, BATCH)
+    model = ResUNet(config=model_config, bn_axis_name=BATCH)
+
+    def apply_fn(params, batch_stats, imgs):
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            imgs,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        return logits, mutated["batch_stats"]
+
+    return _build_round(
+        mesh,
+        model_config,
+        learning_rate,
+        local_epochs,
+        fedprox_mu,
+        inner_axis=BATCH,
+        apply_fn=apply_fn,
+        image_spec=P(CLIENTS, None, BATCH),
+        validate_data=lambda images: None,
+    )
+
+
+def build_spatial_federated_round(
+    mesh: Mesh,
+    model_config: ModelConfig | None = None,
+    learning_rate: float = 1e-3,
+    local_epochs: int = 1,
+    fedprox_mu: float = 0.0,
+):
+    """Federated round over a ``Mesh(('clients', 'space'))``: FedAvg across
+    clients whose local fits are each **spatially sharded** over image
+    height with halo exchange + sync-BN (``parallel.spatial``). This is the
+    composition for crops too large for one chip per client — e.g. 8 chips
+    = 4 clients x 2-way spatial — and trains identically to the plain
+    (clients, batch=1) round on the same data (cross-checked in tests).
+
+    Same signature/contract as :func:`build_federated_round`, with
+    ``images [C, steps, B, H, W, 3]`` sharded ``P('clients', None, None,
+    'space')``; H must be a multiple of 16 x n_space.
+    """
+    from fedcrack_tpu.parallel.spatial import SPACE, _validate_shape, spatial_apply
+
+    model_config = model_config or ModelConfig()
+    _require_axes(mesh, CLIENTS, SPACE)
+    n_space = mesh.shape[SPACE]
+
+    def apply_fn(params, batch_stats, imgs):
+        return spatial_apply(
+            {"params": params, "batch_stats": batch_stats},
+            imgs,
+            config=model_config,
+            axis_name=SPACE,
+            axis_size=n_space,
+            train=True,
+            sync_axes=(SPACE,),
+        )
+
+    return _build_round(
+        mesh,
+        model_config,
+        learning_rate,
+        local_epochs,
+        fedprox_mu,
+        inner_axis=SPACE,
+        apply_fn=apply_fn,
+        image_spec=P(CLIENTS, None, None, SPACE),
+        validate_data=lambda images: _validate_shape(
+            images.shape[3], images.shape[4], n_space
+        ),
+    )
 
 
 @jax.jit
