@@ -10,10 +10,13 @@ from __future__ import annotations
 
 import base64
 import dataclasses
+import logging
 import os
 from typing import Any, Mapping
 
 import orbax.checkpoint as ocp
+
+log = logging.getLogger("fedcrack.ckpt")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -112,7 +115,17 @@ class FedCheckpointer:
                     opt_state=ocp.args.StandardRestore(opt_template)
                 ),
             )
-        except (KeyError, ValueError, FileNotFoundError):
+        except (KeyError, FileNotFoundError):
+            return None  # step predates FedOpt / plain FedAvg was running
+        except ValueError:
+            # The item exists but its structure does not match the template —
+            # e.g. a checkpoint written by an older optimizer implementation.
+            # Callers may retry with a legacy template; never fail silently.
+            log.warning(
+                "server opt_state exists in step %s but does not match the "
+                "current optimizer structure",
+                step,
+            )
             return None
         return restored["opt_state"]
 
@@ -143,6 +156,25 @@ def save_server_state(ckptr: FedCheckpointer, state: Any) -> None:
             server_opt_state=state.server_opt_state,
         )
     )
+
+
+def _migrate_legacy_fedadam(ckptr: FedCheckpointer, params: Any) -> Any | None:
+    """Checkpoints written when FedAdam was optax.adam stored the moments as
+    ``(ScaleByAdamState(count, mu, nu), EmptyState)``; map mu/nu onto the
+    hand-rolled ``(m, v)`` state so upgrading the coordinator keeps its
+    momentum instead of silently re-zeroing it."""
+    import optax
+
+    legacy = ckptr.restore_opt_state(optax.adam(1.0).init(params))
+    if legacy is None:
+        return None
+    try:
+        scale_state = legacy[0]
+        migrated = (scale_state.mu, scale_state.nu)
+    except (TypeError, IndexError, AttributeError):
+        return None
+    log.info("migrated legacy optax.adam FedAdam moments to the paper update")
+    return migrated
 
 
 def restore_server_state(
@@ -176,6 +208,14 @@ def restore_server_state(
     )
     if tx is not None:
         opt_state = ckptr.restore_opt_state(tx.init(ckpt.variables["params"]))
+        if opt_state is None and config.server_optimizer in ("adam", "fedadam"):
+            opt_state = _migrate_legacy_fedadam(ckptr, ckpt.variables["params"])
+        if opt_state is None:
+            log.warning(
+                "no FedOpt moments restored for server_optimizer=%r: the "
+                "server optimizer restarts from zero moments",
+                config.server_optimizer,
+            )
     # Route through initial_state so dtype-dependent derived fields (the
     # float32 decode template, the wire-dtype broadcast copy) are rebuilt
     # consistently with a fresh boot.

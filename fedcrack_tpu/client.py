@@ -14,11 +14,12 @@ from __future__ import annotations
 import argparse
 import logging
 import sys
+import zlib
 
 from fedcrack_tpu.configs import FedConfig
 from fedcrack_tpu.data.pipeline import dataset_from_source, reference_split
 from fedcrack_tpu.train.federated import make_train_fn
-from fedcrack_tpu.transport.client import FedClient
+from fedcrack_tpu.transport.client import FedClient, default_cname
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -86,6 +87,24 @@ def main(argv: list[str] | None = None) -> int:
         # shard and silently leave the rest of the data untrained.
         p.error("--num-clients > 1 requires --client-index")
     client_index = args.client_index if args.client_index is not None else 0
+    cname = args.name or default_cname()
+    data_seed = args.seed + client_index
+    if args.synthetic and args.client_index is None and cfg.cohort_size > 1:
+        # Without --client-index every synthetic cohort member would get the
+        # same seed and train IDENTICAL data — the reference flaw the
+        # sharding work fixes, silently reproduced by the quickstart. Derive
+        # the seed from the unique client name instead so each member
+        # synthesizes a distinct shard.
+        data_seed = args.seed + zlib.crc32(cname.encode())
+        logging.warning(
+            "synthetic data with no --client-index in a %d-member cohort: "
+            "deriving the data seed (%d) from client name %r so cohort "
+            "members train distinct shards; pass --client-index for "
+            "reproducible sharding",
+            cfg.cohort_size,
+            data_seed,
+            cname,
+        )
     if num_clients == 1 and cfg.cohort_size > 1 and not args.synthetic:
         logging.warning(
             "data sharding is OFF (every client would train the same data, "
@@ -122,7 +141,7 @@ def main(argv: list[str] | None = None) -> int:
             args.mask_dir,
             img_size=cfg.model.img_size,
             batch_size=batch,
-            seed=args.seed + client_index,
+            seed=data_seed,
             num_workers=cfg.data.num_workers,
             prefetch=cfg.data.prefetch,
             pair_filter=local_shard,
@@ -138,7 +157,7 @@ def main(argv: list[str] | None = None) -> int:
     train_fn, holder = make_train_fn(
         cfg, dataset, batch, seed=args.seed, metrics_logger=metrics_logger
     )
-    client = FedClient(cfg, train_fn, cname=args.name)
+    client = FedClient(cfg, train_fn, cname=cname)
     result = client.run_session()
     if metrics_logger is not None:
         metrics_logger.log(

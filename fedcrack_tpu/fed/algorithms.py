@@ -73,6 +73,39 @@ def fedprox_penalty(params: Any, anchor: Any, mu: float) -> jax.Array:
 # statistics are plain-averaged (momentum on running moments is meaningless).
 
 
+def _adam_no_bias_correction(lr: float, b1: float, b2: float, eps: float):
+    """Reddi et al.'s FedAdam update, exactly: ``m = b1*m + (1-b1)*g``,
+    ``v = b2*v + (1-b2)*g^2``, step ``-lr * m / (sqrt(v) + eps)`` — with NO
+    bias correction. ``optax.adam`` bias-corrects, which changes the
+    effective step size of early rounds relative to the paper's algorithm,
+    so the server optimizer hand-rolls the two moment updates instead."""
+    import optax
+
+    def init(params):
+        zeros = lambda t: jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, jnp.float32), t
+        )
+        return (zeros(params), zeros(params))
+
+    def update(grads, state, params=None):
+        del params
+        m, v = state
+        m = jax.tree_util.tree_map(
+            lambda mi, g: b1 * mi + (1.0 - b1) * g.astype(jnp.float32), m, grads
+        )
+        v = jax.tree_util.tree_map(
+            lambda vi, g: b2 * vi + (1.0 - b2) * jnp.square(g.astype(jnp.float32)),
+            v,
+            grads,
+        )
+        updates = jax.tree_util.tree_map(
+            lambda mi, vi: -lr * mi / (jnp.sqrt(vi) + eps), m, v
+        )
+        return updates, (m, v)
+
+    return optax.GradientTransformation(init, update)
+
+
 def make_server_optimizer(kind: str, lr: float = 1.0, momentum: float = 0.9):
     """An optax transform for the server update, or None for plain FedAvg."""
     import optax
@@ -82,7 +115,8 @@ def make_server_optimizer(kind: str, lr: float = 1.0, momentum: float = 0.9):
     if kind in ("momentum", "fedavgm"):
         return optax.sgd(lr, momentum=momentum)
     if kind in ("adam", "fedadam"):
-        return optax.adam(lr, b1=0.9, b2=0.99, eps=1e-3)  # paper defaults
+        # Paper hyperparameters AND paper update rule (no bias correction).
+        return _adam_no_bias_correction(lr, b1=0.9, b2=0.99, eps=1e-3)
     raise ValueError(f"unknown server optimizer {kind!r}")
 
 

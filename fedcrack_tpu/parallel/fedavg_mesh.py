@@ -118,6 +118,15 @@ def _build_round(
             # count turns that sum of local-mean gradients into the gradient
             # of the client's full mean loss (a pmean here would be an
             # identity on the already-summed value and double-count).
+            # CAUTION: that AD-inserted psum spans ONLY the inner axis — not
+            # the clients axis — solely because the lax.scan carry makes
+            # params clients-VARYING after step one (carry-vma unification
+            # promotes the whole carry). For fully replicated params the
+            # AD psum spans ALL mesh axes (spatial.py's scan-free step
+            # divides by the product of both axis sizes for exactly that
+            # reason). If this round is ever restructured without the scan,
+            # the divisor must change; test_dp_gradient_not_double_counted
+            # pins the current behavior.
             grads = jax.tree_util.tree_map(lambda g: g / n_inner, grads)
             # BN moments are already pmean-synced inside the forward; this
             # keeps the carried stats bitwise identical across inner shards.

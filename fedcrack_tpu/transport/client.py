@@ -42,6 +42,12 @@ TrainFn = Callable[..., tuple[bytes, int, dict[str, float]]]
 DEFAULT_CHUNK_BYTES = 4 * 1024 * 1024
 
 
+def default_cname() -> str:
+    """A fresh unique client name — the reference drew client{randint(1,100000)}
+    with possible collisions (fl_client.py:26)."""
+    return f"client-{uuid.uuid4().hex[:8]}"
+
+
 @dataclass
 class SessionResult:
     cname: str
@@ -79,9 +85,7 @@ class FedClient:
         # (reference C2.1: the 'L' chunked uploader, fl_client.py:35-50 —
         # present there but its call site was commented out; enabled here).
         self.upload_paths = tuple(upload_paths)
-        # unique by construction — the reference drew client{randint(1,100000)}
-        # with possible collisions (fl_client.py:26)
-        self.cname = cname or f"client-{uuid.uuid4().hex[:8]}"
+        self.cname = cname or default_cname()
         self.port = port if port is not None else config.port
         self.poll_period_s = (
             poll_period_s if poll_period_s is not None else config.poll_period_s

@@ -18,8 +18,10 @@ to a ``'grcp.'`` typo (fl_server.py:215, SURVEY.md §2.2(7)).
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import logging
 import os
+import re
 import time
 from typing import Any, AsyncIterator, Callable
 
@@ -38,10 +40,22 @@ METHOD = "Session"
 
 def _safe_component(name: str) -> str:
     """One path component from an untrusted wire string: separators and
-    parent references become underscores, never a traversal."""
+    parent references become underscores, never a traversal. Injective:
+    any name the sanitizer had to rewrite gets a suffix hashed from the
+    original bytes, so distinct wire names ('a/b' vs 'a_b') can never
+    collapse onto one file and overwrite each other."""
     cleaned = name.replace("\\", "_").replace("/", "_").replace("..", "_")
     cleaned = cleaned.strip() or "_"
-    return cleaned.lstrip(".") or "_"
+    cleaned = cleaned.lstrip(".") or "_"
+    # Names that already look like a hash-suffixed rewrite are suffixed too:
+    # otherwise sending the literal "sanitized.digest" form of another
+    # client's unsafe name (the digest is computable by anyone) would land
+    # on that client's file. Branch ranges stay disjoint — identity output
+    # never matches the tail pattern, suffixed output always does.
+    if cleaned != name or re.search(r"\.[0-9a-f]{8}$", cleaned):
+        digest = hashlib.sha256(name.encode("utf-8", "surrogatepass")).hexdigest()[:8]
+        cleaned = f"{cleaned}.{digest}"
+    return cleaned
 
 
 def channel_options(max_message_mb: int) -> list[tuple[str, int]]:

@@ -312,6 +312,25 @@ class TestFedOpt:
         assert np.all(w1 > 0) and np.all(w2 > w1) and np.all(w2 <= 5.01)
         assert state.server_opt_state is not None
 
+    def test_fedadam_closed_form_no_bias_correction(self):
+        """Reddi et al.'s FedAdam has NO bias correction: x += lr*m/(sqrt(v)+eps)
+        with raw first/second moments. Pins the hand-rolled update against the
+        recurrence (optax.adam's bias-corrected step would differ by ~2e-4 in
+        round 1 here)."""
+        lr, b1, b2, eps = 0.1, 0.9, 0.99, 1e-3
+        cfg = self._cfg(server_optimizer="fedadam", server_lr=lr)
+        _, blobs = self._session(cfg, [5.0, 5.0])
+        x, m, v = 0.0, 0.0, 0.0
+        expected = []
+        for _ in range(2):
+            g = x - 5.0  # pseudo-gradient toward the round average
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            x = x - lr * m / (np.sqrt(v) + eps)
+            expected.append(x)
+        np.testing.assert_allclose(blobs[0]["params"]["w"], expected[0], rtol=1e-5)
+        np.testing.assert_allclose(blobs[1]["params"]["w"], expected[1], rtol=1e-5)
+
     def test_unknown_kind_rejected(self):
         from fedcrack_tpu.fed.algorithms import make_server_optimizer
 

@@ -194,6 +194,27 @@ def test_dead_client_mid_round_cohort_shrinks(session_cfg):
     assert state.cohort == frozenset({"a"})
 
 
+def test_safe_component_injective():
+    """Distinct untrusted wire names must never map to the same file — e.g.
+    titles 'a/b' and 'a_b' previously both became 'a_b', letting one client
+    upload silently overwrite another's log."""
+    from fedcrack_tpu.transport.service import _safe_component
+
+    names = ["a/b", "a_b", "a\\b", "..", "_", " a_b ", "a..b", "a_b.12ab34cd", ".."]
+    mapped = [_safe_component(n) for n in names]
+    # injective over distinct inputs
+    assert len(set(mapped)) == len(set(names))
+    # still never a traversal component
+    for comp in mapped:
+        assert "/" not in comp and "\\" not in comp and ".." not in comp
+        assert not comp.startswith(".")
+    # forging another client's sanitized-form name (the digest is computable
+    # by anyone) must not land on that client's file either
+    assert _safe_component(_safe_component("a/b")) != _safe_component("a/b")
+    # already-safe names pass through unchanged (stable on-disk layout)
+    assert _safe_component("client-metrics.jsonl") == "client-metrics.jsonl"
+
+
 def test_chunked_log_upload_roundtrip(session_cfg, tmp_path):
     """C2.1/C1.5: the client streams a file in chunks; the server accumulates
     and flushes it to logs_dir on the last chunk, with untrusted names
@@ -218,11 +239,17 @@ def test_chunked_log_upload_roundtrip(session_cfg, tmp_path):
     assert result.rounds_completed == cfg.max_rounds
     # flushed buffers are dropped from memory (unbounded-growth guard)
     assert state.logs == {}
-    # disk flush: sanitized path inside the sink, exact bytes
+    # disk flush: sanitized path inside the sink, exact bytes. A rewritten
+    # name carries a hash suffix of the original bytes (injectivity — two
+    # distinct wire names can never collapse onto one file); an already-safe
+    # name like the metrics filename passes through untouched.
+    import hashlib
+
+    evil = "__evil_____escape." + hashlib.sha256(b"../evil/../../escape").hexdigest()[:8]
     sink = tmp_path / "sink"
     flushed = sorted(p for p in sink.rglob("*") if p.is_file())
     assert [p.name for p in flushed] == sorted(
-        ["__evil_____escape", "client-metrics.jsonl"]
+        [evil, "client-metrics.jsonl"]
     ), flushed
     for p in flushed:
         assert p.read_bytes() == payload
