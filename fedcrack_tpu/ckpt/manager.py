@@ -120,10 +120,12 @@ class FedCheckpointer:
         except ValueError:
             # The item exists but its structure does not match the template —
             # e.g. a checkpoint written by an older optimizer implementation.
-            # Callers may retry with a legacy template; never fail silently.
-            log.warning(
-                "server opt_state exists in step %s but does not match the "
-                "current optimizer structure",
+            # Debug-level only: the legacy-migration retry is the NORMAL next
+            # step, and restore_server_state warns loudly if that fails too —
+            # a warning here would fire on every successful migration.
+            log.debug(
+                "server opt_state in step %s does not match the current "
+                "optimizer structure; caller may retry with a legacy template",
                 step,
             )
             return None

@@ -85,6 +85,12 @@ class FedConfig:
     round_deadline_s: float = 0.0  # 0 = no deadline
     # FedProx proximal term; 0 disables (plain FedAvg).
     fedprox_mu: float = 0.0
+    # Crack-pixel loss weight (1 + (pos_weight-1)*mask scales each pixel's
+    # BCE): >1 counters the ~7% foreground imbalance of crack masks, which
+    # under plain BCE converges to low-confidence maps that threshold poorly.
+    # 1.0 is the reference's unweighted BCE (client_fit_model.py:157).
+    # Travels in-band to every client like fedprox_mu.
+    pos_weight: float = 1.0
     # FedOpt server optimizer on the round pseudo-gradient (Reddi et al.):
     # "avg" = plain FedAvg (the reference's behavior), "momentum"/"fedavgm",
     # "adam"/"fedadam". Applied to params only; BN stats are plain-averaged.

@@ -210,6 +210,7 @@ def _ready_config(state: ServerState, status: str) -> dict[str, Any]:
         "local_epochs": state.config.local_epochs,
         "learning_rate": state.config.learning_rate,
         "fedprox_mu": state.config.fedprox_mu,
+        "pos_weight": state.config.pos_weight,
         "wire_dtype": state.config.wire_dtype,
     }
 

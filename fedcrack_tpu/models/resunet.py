@@ -95,6 +95,9 @@ class ResUNet(nn.Module):
 
     config: ModelConfig = ModelConfig()
     bn_axis_name: str | None = None
+    # Keras-parity default; 0.0 turns a train-mode forward into an exact
+    # per-batch moment estimator (used by ``train.recalibrate_batch_stats``).
+    bn_momentum: float = _BN_MOMENTUM
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
@@ -108,7 +111,7 @@ class ResUNet(nn.Module):
         def bn(name: str):
             return nn.BatchNorm(
                 use_running_average=not train,
-                momentum=_BN_MOMENTUM,
+                momentum=self.bn_momentum,
                 epsilon=_BN_EPSILON,
                 dtype=dtype,
                 param_dtype=pdtype,

@@ -17,15 +17,25 @@ import jax.numpy as jnp
 import optax
 
 
-def sigmoid_bce(logits: jax.Array, labels: jax.Array) -> jax.Array:
+def sigmoid_bce(
+    logits: jax.Array,
+    labels: jax.Array,
+    pos_weight: jax.Array | float | None = None,
+) -> jax.Array:
     """Mean binary cross-entropy over all pixels, from logits.
 
     Matches Keras ``binary_crossentropy`` applied to ``sigmoid(logits)`` up to
     clipping; computed as ``max(l,0) - l*y + log1p(exp(-|l|))`` for stability.
+    ``pos_weight`` scales crack-pixel terms by ``1 + (pos_weight-1)*y``
+    (class-imbalance counterweight); ``None``/1.0 is the reference's plain
+    BCE (client_fit_model.py:157).
     """
     logits = logits.astype(jnp.float32)
     labels = labels.astype(jnp.float32)
     per_pixel = optax.sigmoid_binary_cross_entropy(logits, labels)
+    if pos_weight is not None:
+        w = 1.0 + (jnp.asarray(pos_weight, jnp.float32) - 1.0) * labels
+        per_pixel = w * per_pixel
     return jnp.mean(per_pixel)
 
 
@@ -68,11 +78,15 @@ def iou_counts(
     return inter, union
 
 
-def segmentation_metrics(logits: jax.Array, labels: jax.Array) -> dict[str, jax.Array]:
+def segmentation_metrics(
+    logits: jax.Array,
+    labels: jax.Array,
+    pos_weight: jax.Array | float | None = None,
+) -> dict[str, jax.Array]:
     """The per-batch metric dict logged every round (SURVEY.md §5.5 fix)."""
     inter, union = iou_counts(logits, labels)
     return {
-        "loss": sigmoid_bce(logits, labels),
+        "loss": sigmoid_bce(logits, labels, pos_weight),
         "pixel_acc": pixel_accuracy(logits, labels),
         "iou": iou_from_counts(inter, union),
         "iou_inter": inter,

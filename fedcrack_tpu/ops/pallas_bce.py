@@ -72,11 +72,20 @@ def _fwd_kernel(x_ref, y_ref, out_ref, *, n_valid: int, block_rows: int):
     inter = jnp.where(valid & pred & tgt, 1.0, 0.0)
     union = jnp.where(valid & (pred | tgt), 1.0, 0.0)
 
-    s = (jnp.sum(bce), jnp.sum(correct), jnp.sum(inter), jnp.sum(union))
+    # Positive-pixel BCE sum: lets the host compose a class-weighted loss
+    # (w = 1 + (pos_weight-1)*y) for ANY pos_weight from the same kernel —
+    # the weight never becomes a kernel constant, so it never recompiles.
+    s = (
+        jnp.sum(bce),
+        jnp.sum(correct),
+        jnp.sum(inter),
+        jnp.sum(union),
+        jnp.sum(y * bce),
+    )
     orow = jax.lax.broadcasted_iota(jnp.int32, out_ref.shape, 0)
     ocol = jax.lax.broadcasted_iota(jnp.int32, out_ref.shape, 1)
     vec = sum(
-        jnp.where((orow == 0) & (ocol == k), s[k], 0.0) for k in range(4)
+        jnp.where((orow == 0) & (ocol == k), s[k], 0.0) for k in range(5)
     )
 
     @pl.when(i == 0)
@@ -115,19 +124,21 @@ def _sums_pallas(x: jax.Array, y: jax.Array, interpret: bool) -> jax.Array:
         out_shape=jax.ShapeDtypeStruct((8, LANE), jnp.float32, vma=vma),
         interpret=interpret,
     )(xp, yp)
-    return out[0, :4]
+    return out[0, :5]
 
 
 def _sums_jnp(x: jax.Array, y: jax.Array) -> jax.Array:
     x = x.astype(jnp.float32)
     y = y.astype(jnp.float32)
-    bce = jnp.sum(optax.sigmoid_binary_cross_entropy(x, y))
+    per_pixel = optax.sigmoid_binary_cross_entropy(x, y)
+    bce = jnp.sum(per_pixel)
+    ybce = jnp.sum(y * per_pixel)
     pred = x > 0
     tgt = y > 0.5
     correct = jnp.sum((pred == tgt).astype(jnp.float32))
     inter = jnp.sum((pred & tgt).astype(jnp.float32))
     union = jnp.sum((pred | tgt).astype(jnp.float32))
-    return jnp.stack([bce, correct, inter, union])
+    return jnp.stack([bce, correct, inter, union, ybce])
 
 
 # ---- differentiable public op ----
@@ -135,12 +146,15 @@ def _sums_jnp(x: jax.Array, y: jax.Array) -> jax.Array:
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def bce_sums(logits: jax.Array, labels: jax.Array, impl: str = "jnp") -> jax.Array:
-    """``[bce_sum, n_correct, iou_inter, iou_union]`` as one float32 vector.
+    """``[bce_sum, n_correct, iou_inter, iou_union, pos_bce_sum]`` as one
+    float32 vector (``pos_bce_sum`` = BCE summed over crack pixels only, the
+    building block of a class-weighted loss).
 
     ``impl``: ``"pallas"`` (compiled TPU kernel), ``"interpret"`` (Pallas
     interpreter, any backend — for tests), ``"jnp"`` (pure XLA reference).
-    Differentiable in ``logits``/``labels`` through the BCE-sum component;
-    the count statistics are piecewise constant with zero gradient.
+    Differentiable in ``logits``/``labels`` through the two BCE-sum
+    components; the count statistics are piecewise constant with zero
+    gradient.
     """
     return _dispatch(logits, labels, impl)
 
@@ -163,10 +177,14 @@ def _bce_sums_bwd(impl, residuals, g):
     x, y = residuals
     x32 = x.astype(jnp.float32)
     y32 = y.astype(jnp.float32)
-    # d(bce_sum)/dx = sigmoid(x) - y ; d(bce_sum)/dy = -x. Count statistics
-    # (g[1:]) are piecewise constant: zero gradient.
-    dx = (g[0] * (jax.nn.sigmoid(x32) - y32)).astype(x.dtype)
-    dy = (g[0] * (-x32)).astype(y.dtype)
+    # d(bce_sum)/dx = sigmoid(x) - y ; d(bce_sum)/dy = -x.
+    # d(pos_bce_sum)/dx = y * (sigmoid(x) - y) ;
+    # d(pos_bce_sum)/dy = bce + y * d(bce)/dy = bce - y*x.
+    # Count statistics (g[1:4]) are piecewise constant: zero gradient.
+    sig_minus_y = jax.nn.sigmoid(x32) - y32
+    dx = ((g[0] + g[4] * y32) * sig_minus_y).astype(x.dtype)
+    bce = jnp.maximum(x32, 0.0) - x32 * y32 + jnp.log1p(jnp.exp(-jnp.abs(x32)))
+    dy = (g[0] * (-x32) + g[4] * (bce - y32 * x32)).astype(y.dtype)
     return dx, dy
 
 
@@ -186,15 +204,29 @@ def default_impl() -> str:
 
 
 def fused_segmentation_metrics(
-    logits: jax.Array, labels: jax.Array, impl: str | None = None
+    logits: jax.Array,
+    labels: jax.Array,
+    impl: str | None = None,
+    pos_weight: jax.Array | float | None = None,
 ) -> dict[str, jax.Array]:
-    """Drop-in fused equivalent of ``ops.losses.segmentation_metrics``."""
+    """Drop-in fused equivalent of ``ops.losses.segmentation_metrics``.
+
+    ``pos_weight`` > 1 up-weights crack pixels in the loss (mean of
+    ``(1 + (pos_weight-1)*y) * bce``) — the standard counter to the ~7%
+    foreground imbalance of crack masks, where plain BCE converges to
+    low-confidence predictions that threshold poorly. ``None``/1.0 is the
+    reference's plain BCE (client_fit_model.py:157). Traced, never a
+    compile-time constant: sweeping it does not recompile.
+    """
     from fedcrack_tpu.ops.losses import iou_from_counts
 
     sums = bce_sums(logits, labels, impl or default_impl())
     n = jnp.float32(logits.size)
+    loss = sums[0] / n
+    if pos_weight is not None:
+        loss = loss + (jnp.asarray(pos_weight, jnp.float32) - 1.0) * sums[4] / n
     return {
-        "loss": sums[0] / n,
+        "loss": loss,
         "pixel_acc": sums[1] / n,
         "iou": iou_from_counts(sums[2], sums[3]),
         "iou_inter": sums[2],

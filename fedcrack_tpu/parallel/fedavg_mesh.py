@@ -73,6 +73,7 @@ def _build_round(
     apply_fn,
     image_spec: P,
     validate_data,
+    pos_weight: float = 1.0,
 ):
     """Shared core of the one-program federated round.
 
@@ -85,6 +86,7 @@ def _build_round(
     """
     tx = make_optimizer(learning_rate)
     mu = float(fedprox_mu)
+    pw = float(pos_weight)
     n_client_shards = mesh.shape[CLIENTS]
     n_inner = mesh.shape[inner_axis]
 
@@ -97,6 +99,7 @@ def _build_round(
         anchor = params  # FedProx anchor = this round's global weights
         opt_state = tx.init(params)
         mu_arr = jnp.asarray(mu, jnp.float32)
+        pw_arr = jnp.asarray(pw, jnp.float32)
 
         def sgd_step(carry, batch):
             params, batch_stats, opt_state = carry
@@ -106,7 +109,7 @@ def _build_round(
                 logits, new_stats = apply_fn(p, batch_stats, imgs)
                 # One fused pass for BCE + all statistics (Pallas kernel on
                 # TPU, XLA reference elsewhere — ops/pallas_bce.py).
-                m = fused_segmentation_metrics(logits, msks)
+                m = fused_segmentation_metrics(logits, msks, pos_weight=pw_arr)
                 prox = fedprox_penalty(p, anchor, mu_arr)
                 return m["loss"] + prox, (m, new_stats)
 
@@ -217,6 +220,7 @@ def build_federated_round(
     learning_rate: float = 1e-3,
     local_epochs: int = 1,
     fedprox_mu: float = 0.0,
+    pos_weight: float = 1.0,
 ):
     """Compile-once round function over ``Mesh(('clients', 'batch'))``.
 
@@ -261,6 +265,7 @@ def build_federated_round(
         apply_fn=apply_fn,
         image_spec=P(CLIENTS, None, BATCH),
         validate_data=lambda images: None,
+        pos_weight=pos_weight,
     )
 
 
@@ -270,6 +275,7 @@ def build_spatial_federated_round(
     learning_rate: float = 1e-3,
     local_epochs: int = 1,
     fedprox_mu: float = 0.0,
+    pos_weight: float = 1.0,
 ):
     """Federated round over a ``Mesh(('clients', 'space'))``: FedAvg across
     clients whose local fits are each **spatially sharded** over image
@@ -311,6 +317,7 @@ def build_spatial_federated_round(
         validate_data=lambda images: _validate_shape(
             images.shape[3], images.shape[4], n_space
         ),
+        pos_weight=pos_weight,
     )
 
 

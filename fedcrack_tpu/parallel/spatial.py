@@ -333,6 +333,7 @@ def build_spatial_train_step(
     batch_axis: str = DATA,
     space_axis: str = SPACE,
     tx: optax.GradientTransformation | None = None,
+    pos_weight: float = 1.0,
 ):
     """Compile-once sharded train step, numerically equivalent to the
     single-device :func:`fedcrack_tpu.train.local.train_step` (Adam + fused
@@ -348,6 +349,7 @@ def build_spatial_train_step(
     s = mesh.shape[space_axis]
     spec = _image_spec(mesh, batch_axis, space_axis)
     sync = tuple(a for a in (batch_axis, space_axis) if a in mesh.shape)
+    pw = float(pos_weight)
 
     def step(params, batch_stats, opt_state, images, masks):
         def loss_fn(prm):
@@ -360,7 +362,9 @@ def build_spatial_train_step(
                 train=True,
                 sync_axes=sync,
             )
-            m = fused_segmentation_metrics(logits, masks)
+            m = fused_segmentation_metrics(
+                logits, masks, pos_weight=jnp.asarray(pw, jnp.float32)
+            )
             return m["loss"], (m, new_stats)
 
         (_, (m, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(

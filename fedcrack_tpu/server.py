@@ -32,6 +32,13 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
     p.add_argument("--round-deadline", type=float, dest="round_deadline_s")
     p.add_argument("--fedprox-mu", type=float, dest="fedprox_mu")
     p.add_argument(
+        "--pos-weight",
+        type=float,
+        dest="pos_weight",
+        help="crack-pixel BCE weight for every client's local fit (>1 "
+        "counters the foreground imbalance; 1 = reference's plain BCE)",
+    )
+    p.add_argument(
         "--server-optimizer",
         dest="server_optimizer",
         help="FedOpt server update: avg (plain FedAvg), momentum/fedavgm, "
@@ -95,6 +102,7 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         ("registration_window_s", "registration_window_s"),
         ("round_deadline_s", "round_deadline_s"),
         ("fedprox_mu", "fedprox_mu"),
+        ("pos_weight", "pos_weight"),
         ("server_optimizer", "server_optimizer"),
         ("server_lr", "server_lr"),
         ("server_momentum", "server_momentum"),
@@ -129,7 +137,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.eval_synthetic or (args.eval_image_dir and args.eval_mask_dir):
         from fedcrack_tpu.data.pipeline import dataset_from_source
         from fedcrack_tpu.fed.serialization import tree_from_bytes
-        from fedcrack_tpu.train.local import evaluate
+        from fedcrack_tpu.train.local import evaluate, recalibrate_batch_stats
 
         eval_dataset = dataset_from_source(
             args.eval_synthetic,
@@ -145,6 +153,11 @@ def main(argv: list[str] | None = None) -> int:
             st = state.replace_variables(
                 tree_from_bytes(blob, template=state.variables)
             )
+            # A freshly averaged global model carries mixed, under-converged
+            # BN running stats (momentum 0.99 needs ~500 steps); re-estimate
+            # them from the eval images (labels never enter calibration) so
+            # the reported loss/IoU reflects the params, not stale moments.
+            st = recalibrate_batch_stats(st, eval_dataset, cfg.model)
             return evaluate(st, eval_dataset)
 
     if cfg.init_weights:

@@ -4,5 +4,6 @@ from fedcrack_tpu.train.local import (  # noqa: F401
     eval_step,
     evaluate,
     local_fit,
+    recalibrate_batch_stats,
     train_step,
 )

@@ -18,7 +18,13 @@ import jax
 
 from fedcrack_tpu.configs import ModelConfig
 from fedcrack_tpu.fed.serialization import tree_to_bytes
-from fedcrack_tpu.train.local import TrainState, create_train_state, evaluate, local_fit
+from fedcrack_tpu.train.local import (
+    TrainState,
+    create_train_state,
+    evaluate,
+    local_fit,
+    recalibrate_batch_stats,
+)
 
 
 def train_centralized(
@@ -30,16 +36,34 @@ def train_centralized(
     out_dir: str | None = None,
     seed: int = 0,
     log_fn=print,
+    recalibrate_bn: bool = True,
+    pos_weight: float = 1.0,
 ) -> tuple[TrainState, list[dict]]:
     """Returns the final state and per-epoch history; writes
     ``best.msgpack`` (lowest val loss) and ``final.msgpack`` to ``out_dir``.
+
+    ``recalibrate_bn`` re-estimates BatchNorm running statistics from the
+    train set before every validation pass and checkpoint (one extra forward
+    sweep per epoch): with Keras-parity BN momentum 0.99 the running stats
+    need ~500 steps to converge, so short runs would otherwise select the
+    "best" checkpoint with near-initialization statistics. The saved
+    checkpoints carry the calibrated stats; the in-training running-stat
+    dynamics are untouched.
     """
     state = create_train_state(jax.random.key(seed), model_config, learning_rate)
     history: list[dict] = []
     best_loss = float("inf")
+    eval_state = state
     for epoch in range(epochs):
-        state, train_metrics = local_fit(state, train_batches, epochs=1)
-        val_metrics = evaluate(state, val_batches)
+        state, train_metrics = local_fit(
+            state, train_batches, epochs=1, pos_weight=pos_weight
+        )
+        eval_state = (
+            recalibrate_batch_stats(state, train_batches, model_config)
+            if recalibrate_bn
+            else state
+        )
+        val_metrics = evaluate(eval_state, val_batches)
         entry = {
             "epoch": epoch,
             **{f"train_{k}": v for k, v in train_metrics.items()},
@@ -52,10 +76,10 @@ def train_centralized(
         )
         if out_dir and val_metrics["loss"] < best_loss:
             best_loss = val_metrics["loss"]
-            _save(state, os.path.join(out_dir, "best.msgpack"))
+            _save(eval_state, os.path.join(out_dir, "best.msgpack"))
     if out_dir:
-        _save(state, os.path.join(out_dir, "final.msgpack"))
-    return state, history
+        _save(eval_state, os.path.join(out_dir, "final.msgpack"))
+    return eval_state, history
 
 
 def _build_datasets(args, model_config: ModelConfig):
@@ -126,6 +150,13 @@ def main(argv=None) -> None:
     p.add_argument("--batch", type=int, default=16)
     p.add_argument("--img-size", type=int, default=128)
     p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument(
+        "--pos-weight",
+        type=float,
+        default=1.0,
+        help="crack-pixel BCE weight (>1 counters foreground imbalance; "
+        "1 = the reference's plain BCE)",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--train-samples", type=int, default=6213)
     p.add_argument("--split-seed", type=int, default=1337)
@@ -142,6 +173,7 @@ def main(argv=None) -> None:
         learning_rate=args.lr,
         out_dir=args.out_dir,
         seed=args.seed,
+        pos_weight=args.pos_weight,
     )
     best = min(h["val_loss"] for h in history)
     print(f"done: {len(history)} epochs, best val_loss={best:.4f} -> {args.out_dir}")

@@ -61,6 +61,7 @@ def make_train_fn(
         hparams = hparams or {}
         epochs = int(hparams.get("local_epochs", config.local_epochs))
         mu = float(hparams.get("fedprox_mu", config.fedprox_mu))
+        pos_weight = float(hparams.get("pos_weight", config.pos_weight))
         lr = float(hparams.get("learning_rate", config.learning_rate))
         wire_dtype = str(hparams.get("wire_dtype", config.wire_dtype))
         variables = tree_from_bytes(blob, template=template)
@@ -76,6 +77,7 @@ def make_train_fn(
                 epochs=epochs,
                 mu=mu,
                 anchor_params=st.params,
+                pos_weight=pos_weight,
             )
         holder["state"] = st
         n_samples = int(metrics.pop("num_steps", 0) * batch_size)

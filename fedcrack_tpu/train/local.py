@@ -88,11 +88,14 @@ def train_step(
     batch: tuple[jax.Array, jax.Array],
     anchor_params: core.FrozenDict[str, Any],
     mu: jax.Array,
+    pos_weight: jax.Array = 1.0,
 ) -> tuple[TrainState, dict[str, jax.Array]]:
     """One SGD step: BCE + (mu/2)||params - anchor||^2, BN stats updated.
 
     For plain FedAvg pass ``anchor_params=state.params`` and ``mu=0.0`` —
-    same compiled program either way.
+    same compiled program either way. ``pos_weight`` (traced, default 1 =
+    reference parity) up-weights crack pixels against the ~7% foreground
+    imbalance.
     """
     images, masks = batch
 
@@ -105,7 +108,7 @@ def train_step(
         )
         # One fused pass for BCE + all statistics (Pallas kernel on TPU,
         # XLA reference elsewhere — ops/pallas_bce.py).
-        metrics = fused_segmentation_metrics(logits, masks)
+        metrics = fused_segmentation_metrics(logits, masks, pos_weight=pos_weight)
         prox = fedprox_penalty(params, anchor_params, mu)
         return metrics["loss"] + prox, (metrics, mutated["batch_stats"])
 
@@ -157,6 +160,68 @@ def evaluate(state: TrainState, batches: Iterable) -> dict[str, float]:
     }
 
 
+@functools.lru_cache(maxsize=8)
+def _calibration_forward(model_config: ModelConfig):
+    """Jitted momentum-0 train-mode forward, cached per model config so
+    per-epoch recalibration never re-traces the U-Net."""
+    model = ResUNet(config=model_config, bn_momentum=0.0)
+
+    @jax.jit
+    def moments_of(params, batch_stats, images):
+        _, mutated = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            images,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        return mutated["batch_stats"]
+
+    return moments_of
+
+
+def recalibrate_batch_stats(
+    state: TrainState,
+    batches: Iterable,
+    model_config: ModelConfig | None = None,
+) -> TrainState:
+    """Re-estimate BatchNorm running statistics from data (SWA-style BN
+    re-estimation): train-mode forwards with momentum 0 yield each batch's
+    exact moments; their average replaces the carried running stats. Uses
+    images only — labels never enter the calibration.
+
+    Why this exists: Keras-parity BN momentum is 0.99 (the reference relies
+    on the default, client_fit_model.py:92-150), so running stats need
+    ~500 steps to converge. The reference trains ~3880 steps per round and
+    never notices; a short local fit — or a freshly FedAvg-averaged global
+    model, whose running stats are a mixture of clients' — evaluates with
+    near-initialization statistics and predicts garbage in inference mode.
+    One pass over a calibration set fixes the stats without touching params.
+    """
+    moments_of = _calibration_forward(model_config or ModelConfig())
+    # Datasets advance their shuffle epoch on every iteration; calibration is
+    # order-independent and must not perturb the training shuffle sequence
+    # (a seeded run has to reproduce bit-for-bit with calibration on or off).
+    epoch_snapshot = getattr(batches, "_epoch", None)
+    try:
+        acc = None
+        n = 0
+        for images, _ in batches:
+            stats = moments_of(state.params, state.batch_stats, jnp.asarray(images))
+            acc = (
+                stats
+                if acc is None
+                else jax.tree_util.tree_map(jnp.add, acc, stats)
+            )
+            n += 1
+    finally:
+        if epoch_snapshot is not None:
+            batches._epoch = epoch_snapshot
+    if n == 0:
+        raise ValueError("empty calibration set")
+    mean_stats = jax.tree_util.tree_map(lambda a: a / n, acc)
+    return state.replace(batch_stats=mean_stats)
+
+
 def local_fit(
     state: TrainState,
     train_batches: Iterable,
@@ -164,6 +229,7 @@ def local_fit(
     mu: float = 0.0,
     anchor_params: core.FrozenDict[str, Any] | None = None,
     prefetch: int = 2,
+    pos_weight: float = 1.0,
 ) -> tuple[TrainState, dict[str, float]]:
     """One federated client's local fit for a round.
 
@@ -176,12 +242,13 @@ def local_fit(
 
     anchor = anchor_params if anchor_params is not None else state.params
     mu_arr = jnp.asarray(mu, jnp.float32)
+    pw_arr = jnp.asarray(pos_weight, jnp.float32)
     last: dict[str, float] = {}
     for _ in range(max(1, epochs)):
         n = 0
         acc: dict[str, float] = {}
         for batch in device_prefetch(train_batches, prefetch):
-            state, metrics = train_step(state, batch, anchor, mu_arr)
+            state, metrics = train_step(state, batch, anchor, mu_arr, pw_arr)
             n += 1
             for k, v in metrics.items():
                 acc[k] = acc.get(k, 0.0) + float(v)

@@ -51,9 +51,12 @@ def _safe_component(name: str) -> str:
     # otherwise sending the literal "sanitized.digest" form of another
     # client's unsafe name (the digest is computable by anyone) would land
     # on that client's file. Branch ranges stay disjoint — identity output
-    # never matches the tail pattern, suffixed output always does.
-    if cleaned != name or re.search(r"\.[0-9a-f]{8}$", cleaned):
-        digest = hashlib.sha256(name.encode("utf-8", "surrogatepass")).hexdigest()[:8]
+    # never matches the tail pattern, suffixed output always does. 16 hex
+    # chars (64 bits) keeps the collision out of brute-force range — with 8
+    # an attacker could enumerate variants cleaning to the same stem until
+    # the truncated digest matched a victim's.
+    if cleaned != name or re.search(r"\.[0-9a-f]{16}$", cleaned):
+        digest = hashlib.sha256(name.encode("utf-8", "surrogatepass")).hexdigest()[:16]
         cleaned = f"{cleaned}.{digest}"
     return cleaned
 

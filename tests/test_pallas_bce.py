@@ -82,6 +82,48 @@ def test_label_gradient_is_correct():
     )
 
 
+def test_pos_weight_loss_matches_weighted_bce():
+    """pos_weight composes from the kernel's pos_bce_sum lane: the loss must
+    equal mean((1 + (pw-1)*y) * bce) exactly, and pw=1 must be plain BCE."""
+    import optax
+
+    logits, masks = _data(4096, seed=23)
+    pw = 3.0
+    w = 1.0 + (pw - 1.0) * masks
+    ref_loss = jnp.mean(w * optax.sigmoid_binary_cross_entropy(logits, masks))
+    for impl in ("interpret", "jnp"):
+        fused = fused_segmentation_metrics(logits, masks, impl=impl, pos_weight=pw)
+        np.testing.assert_allclose(float(fused["loss"]), float(ref_loss), rtol=1e-5)
+        one = fused_segmentation_metrics(logits, masks, impl=impl, pos_weight=1.0)
+        plain = fused_segmentation_metrics(logits, masks, impl=impl)
+        np.testing.assert_allclose(float(one["loss"]), float(plain["loss"]), rtol=1e-6)
+        # counts are weight-independent
+        assert float(fused["iou_inter"]) == float(plain["iou_inter"])
+
+
+def test_pos_weight_gradient_matches_reference():
+    import optax
+
+    logits, masks = _data(2048, seed=29)
+    pw = jnp.float32(5.0)
+    w = 1.0 + (pw - 1.0) * masks
+
+    def loss_fused(x):
+        return fused_segmentation_metrics(
+            x, masks, impl="interpret", pos_weight=pw
+        )["loss"]
+
+    def loss_ref(x):
+        return jnp.mean(w * optax.sigmoid_binary_cross_entropy(x, masks))
+
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(loss_fused)(logits)),
+        np.asarray(jax.grad(loss_ref)(logits)),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+
+
 def test_bfloat16_inputs_accumulate_in_f32():
     logits, masks = _data(8192, seed=7)
     ker = bce_sums(logits.astype(jnp.bfloat16), masks.astype(jnp.bfloat16), "interpret")

@@ -58,7 +58,9 @@ def _assert_trees_match(got, want, atol=2e-5):
         )
 
 
-def _host_round(variables, images, masks, active, n_samples, lr, epochs=1):
+def _host_round(
+    variables, images, masks, active, n_samples, lr, epochs=1, pos_weight=1.0
+):
     """Reference implementation: sequential jitted steps + host fedavg."""
     trained, weights = [], []
     for c in range(images.shape[0]):
@@ -68,7 +70,11 @@ def _host_round(variables, images, masks, active, n_samples, lr, epochs=1):
             for s in range(images.shape[1]):
                 batch = (jnp.asarray(images[c, s]), jnp.asarray(masks[c, s]))
                 state, _ = train_step(
-                    state, batch, variables["params"], jnp.float32(0.0)
+                    state,
+                    batch,
+                    variables["params"],
+                    jnp.float32(0.0),
+                    jnp.float32(pos_weight),
                 )
         if active[c]:
             trained.append(state.variables)
@@ -91,6 +97,24 @@ class TestMeshMatchesHost:
         _assert_trees_match(got, want)
         assert metrics["loss"].shape == (8,)
         assert np.all(np.isfinite(np.asarray(metrics["loss"])))
+
+    def test_pos_weight_round_equals_host_round(self):
+        """Crack-pixel loss weighting must train identically on both planes
+        (and actually change the trajectory vs plain BCE)."""
+        mesh = make_mesh(4, 1)
+        images, masks = _client_data(4)
+        variables = create_train_state(jax.random.key(11), TINY).variables
+        active = np.ones(4, np.float32)
+        n_samples = np.full(4, 8.0, np.float32)
+
+        round_fn = build_federated_round(mesh, TINY, learning_rate=1e-3, pos_weight=5.0)
+        got, _ = round_fn(variables, images, masks, active, n_samples)
+        want = _host_round(variables, images, masks, active, n_samples, 1e-3, pos_weight=5.0)
+        _assert_trees_match(got, want)
+        plain = _host_round(variables, images, masks, active, n_samples, 1e-3)
+        leaves_w = jax.tree_util.tree_leaves(want["params"])
+        leaves_p = jax.tree_util.tree_leaves(plain["params"])
+        assert any(not np.allclose(w, p) for w, p in zip(leaves_w, leaves_p))
 
     def test_masked_cohort_shrinks_divisor(self):
         """Dropped clients (active=0) must not pollute the average and the

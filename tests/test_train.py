@@ -104,6 +104,64 @@ def test_centralized_trainer_checkpoints_best(tmp_path, fixture_data):
     assert all(np.array_equal(g, w) for g, w in zip(got, want))
 
 
+@pytest.mark.slow
+def test_centralized_reaches_iou_floor():
+    """The framework must SEGMENT CRACKS, not just minimize a scalar: the
+    centralized trainer (reference: test/Segmentation.py, quality-gated by
+    val checkpointing at :177-186) on the synthetic fixture must localize
+    cracks to val IoU >= 0.2 within 12 epochs. Measured headroom: ~0.27-0.28
+    final IoU at this config (64px, 64 train / 16 val, pos_weight 5); a
+    regression in the model, loss, data pipeline, BN handling, or recalibration
+    pulls this under the floor."""
+    from fedcrack_tpu.train.centralized import train_centralized
+
+    cfg = ModelConfig(img_size=64)
+    images, masks = synth_crack_batch(80, 64, seed=0)
+    train_ds = ArrayDataset(images[:64], masks[:64], batch_size=8, seed=0)
+    val_ds = ArrayDataset(images[64:], masks[64:], batch_size=8, shuffle=False)
+    _, history = train_centralized(
+        train_ds,
+        val_ds,
+        cfg,
+        epochs=12,
+        learning_rate=1e-3,
+        pos_weight=5.0,
+        log_fn=lambda s: None,
+    )
+    ious = [h["val_iou"] for h in history]
+    assert ious[-1] >= 0.2, f"final val IoU {ious[-1]:.3f} under the 0.2 floor: {ious}"
+    # and learning actually progressed (not a lucky init)
+    assert ious[-1] > ious[0] + 0.05, ious
+
+
+def test_recalibrate_batch_stats_fixes_eval_mode():
+    """Keras-parity BN momentum (0.99) leaves running stats near init after a
+    short fit, collapsing inference-mode predictions; recalibration must
+    recover eval-mode quality to (approximately) train-mode levels."""
+    from fedcrack_tpu.train import recalibrate_batch_stats
+
+    images, masks = synth_crack_batch(16, 32, seed=0)
+    ds = ArrayDataset(images, masks, batch_size=8, seed=0)
+    state = create_train_state(jax.random.key(0), CFG32, learning_rate=1e-3)
+    state, _ = local_fit(state, ds, epochs=4, pos_weight=5.0)
+    stale = evaluate(state, ds)
+    cal = recalibrate_batch_stats(state, ds, CFG32)
+    fresh = evaluate(cal, ds)
+    # params untouched; only batch_stats move
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.params), jax.tree_util.tree_leaves(cal.params)
+    ):
+        assert np.array_equal(a, b)
+    assert fresh["loss"] < stale["loss"], (stale, fresh)
+    # calibration must not advance the dataset's shuffle epoch — a seeded
+    # run has to reproduce identically with calibration on or off
+    epoch_before = ds._epoch
+    recalibrate_batch_stats(state, ds, CFG32)
+    assert ds._epoch == epoch_before
+    with pytest.raises(ValueError):
+        recalibrate_batch_stats(state, [], CFG32)
+
+
 def test_make_train_fn_honors_handshake_hparams():
     """Server hparams override the client config: epochs shows up in the
     jitted step count, and a changed lr rebuilds the optimizer."""

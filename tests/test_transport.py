@@ -245,7 +245,7 @@ def test_chunked_log_upload_roundtrip(session_cfg, tmp_path):
     # name like the metrics filename passes through untouched.
     import hashlib
 
-    evil = "__evil_____escape." + hashlib.sha256(b"../evil/../../escape").hexdigest()[:8]
+    evil = "__evil_____escape." + hashlib.sha256(b"../evil/../../escape").hexdigest()[:16]
     sink = tmp_path / "sink"
     flushed = sorted(p for p in sink.rglob("*") if p.is_file())
     assert [p.name for p in flushed] == sorted(
