@@ -158,7 +158,7 @@ def main(argv: list[str] | None = None) -> int:
             # them from the eval images (labels never enter calibration) so
             # the reported loss/IoU reflects the params, not stale moments.
             st = recalibrate_batch_stats(st, eval_dataset, cfg.model)
-            return evaluate(st, eval_dataset)
+            return evaluate(st, eval_dataset, pos_weight=cfg.pos_weight)
 
     if cfg.init_weights:
         from fedcrack_tpu.fed.serialization import tree_from_bytes

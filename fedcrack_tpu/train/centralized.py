@@ -63,7 +63,10 @@ def train_centralized(
             if recalibrate_bn
             else state
         )
-        val_metrics = evaluate(eval_state, val_batches)
+        # Same objective as training: weighted val loss drives best-checkpoint
+        # selection, otherwise pos_weight>1 runs would checkpoint the
+        # low-recall model the weighting exists to avoid.
+        val_metrics = evaluate(eval_state, val_batches, pos_weight=pos_weight)
         entry = {
             "epoch": epoch,
             **{f"train_{k}": v for k, v in train_metrics.items()},

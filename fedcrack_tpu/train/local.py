@@ -130,21 +130,29 @@ def train_step(
 
 @jax.jit
 def eval_step(
-    state: TrainState, batch: tuple[jax.Array, jax.Array]
+    state: TrainState,
+    batch: tuple[jax.Array, jax.Array],
+    pos_weight: jax.Array = 1.0,
 ) -> dict[str, jax.Array]:
-    """Inference-mode metrics (running BN stats)."""
+    """Inference-mode metrics (running BN stats). ``pos_weight`` must match
+    the training objective: selecting checkpoints by unweighted val loss
+    while training a weighted objective would prefer exactly the
+    low-recall models the weighting exists to avoid."""
     images, masks = batch
     logits = state.apply_fn(state.variables, images, train=False)
-    return fused_segmentation_metrics(logits, masks)
+    return fused_segmentation_metrics(logits, masks, pos_weight=pos_weight)
 
 
-def evaluate(state: TrainState, batches: Iterable) -> dict[str, float]:
+def evaluate(
+    state: TrainState, batches: Iterable, pos_weight: float = 1.0
+) -> dict[str, float]:
     """Aggregate metrics over a validation set: loss/acc averaged per batch,
     IoU from summed global counts (exact, shard-composable)."""
+    pw_arr = jnp.asarray(pos_weight, jnp.float32)
     n = 0
     loss = acc = inter = union = 0.0
     for batch in batches:
-        m = eval_step(state, batch)
+        m = eval_step(state, batch, pw_arr)
         loss += float(m["loss"])
         acc += float(m["pixel_acc"])
         inter += float(m["iou_inter"])

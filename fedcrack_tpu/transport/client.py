@@ -25,6 +25,7 @@ import grpc
 
 from fedcrack_tpu.configs import FedConfig
 from fedcrack_tpu.fed import rounds as R
+from fedcrack_tpu.native import crc32c
 from fedcrack_tpu.transport import transport_pb2 as pb
 from fedcrack_tpu.transport.codec import decode_scalar_map, encode_scalar_map
 from fedcrack_tpu.transport.service import METHOD, SERVICE_NAME, channel_options
@@ -250,6 +251,12 @@ class FedClient:
                     msg.log.data = data
                     msg.log.offset = offset
                     msg.log.last = last
+                    # Integrity framing per chunk (hardware CRC32C when the
+                    # native runtime is built); the reference's chunker had
+                    # none (fl_client.py:35-50). The server rejects
+                    # mismatches, so a corrupt chunk fails loudly here
+                    # instead of silently landing bad bytes in the sink.
+                    msg.log.crc32c = crc32c(data)
                     rep = self._call(method, msg)
                     if rep.status != "OK":
                         # e.g. the server lost its buffer (restart/flush) and

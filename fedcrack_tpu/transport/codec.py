@@ -51,6 +51,16 @@ def event_from_message(msg: pb.ClientMessage, now: float) -> R.Event:
     if kind == "training":
         return R.TrainingNotice(cname=cname, now=now)
     if kind == "log":
+        if msg.log.HasField("crc32c"):
+            from fedcrack_tpu.native import crc32c
+
+            got = crc32c(msg.log.data)
+            if got != msg.log.crc32c:
+                raise ValueError(
+                    f"log chunk checksum mismatch for {msg.log.title!r} at "
+                    f"offset {msg.log.offset}: computed {got:#010x}, "
+                    f"declared {msg.log.crc32c:#010x}"
+                )
         return R.LogChunk(
             cname=cname,
             title=msg.log.title,

@@ -54,8 +54,9 @@ def _safe_component(name: str) -> str:
     # never matches the tail pattern, suffixed output always does. 16 hex
     # chars (64 bits) keeps the collision out of brute-force range — with 8
     # an attacker could enumerate variants cleaning to the same stem until
-    # the truncated digest matched a victim's.
-    if cleaned != name or re.search(r"\.[0-9a-f]{16}$", cleaned):
+    # the truncated digest matched a victim's. The 8-hex alternative keeps
+    # guarding files a pre-16-hex server wrote into a persisted logs_dir.
+    if cleaned != name or re.search(r"\.[0-9a-f]{8}(?:[0-9a-f]{8})?$", cleaned):
         digest = hashlib.sha256(name.encode("utf-8", "surrogatepass")).hexdigest()[:16]
         cleaned = f"{cleaned}.{digest}"
     return cleaned
@@ -195,7 +196,13 @@ class FedServer:
     ) -> AsyncIterator[pb.ServerMessage]:
         async for msg in request_iterator:
             try:
-                event = event_from_message(msg, now=self._clock())
+                # Decode (and CRC-verify log chunks) off the event loop: the
+                # pure-Python CRC fallback costs ~0.3 s/MiB, which inline
+                # would stall every other client's stream and the
+                # round-deadline ticks behind one large upload.
+                event = await asyncio.to_thread(
+                    event_from_message, msg, now=self._clock()
+                )
             except (ValueError, TypeError) as e:
                 yield pb.ServerMessage(status=R.REJECTED, title=str(e))
                 continue

@@ -125,6 +125,39 @@ def test_weighted_accumulate_validates():
         )
 
 
+def test_fedavg_native_path_matches_jnp(rng):
+    """The gRPC server's aggregation (all-f32-numpy trees) takes the native
+    accumulate/scale kernels; the result must match the jnp path bit-for-ulp.
+    Device-array trees must silently take the jnp path."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedcrack_tpu.fed.algorithms import _fedavg_native, fedavg
+
+    def tree(seed):
+        r = np.random.RandomState(seed)
+        return {
+            "params": {"w": r.randn(33, 7).astype(np.float32)},
+            "batch_stats": {"bn": {"mean": r.randn(129).astype(np.float32)}},
+        }
+
+    updates = [tree(s) for s in range(3)]
+    weights = [8.0, 16.0, 8.0]
+    assert _fedavg_native(updates, weights) is not None  # fast path engaged
+    got = fedavg(updates, weights)
+    jnp_updates = [jax.tree_util.tree_map(jnp.asarray, u) for u in updates]
+    want = fedavg(jnp_updates, weights)
+    for g, w in zip(
+        jax.tree_util.tree_leaves(got), jax.tree_util.tree_leaves(want)
+    ):
+        assert isinstance(g, np.ndarray)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=1e-6, atol=1e-7)
+    # mixed dtype falls back (returns None from the native probe)
+    bad = [tree(0), tree(1)]
+    bad[1]["params"]["w"] = bad[1]["params"]["w"].astype(np.float64)
+    assert _fedavg_native(bad, [1.0, 1.0]) is None
+
+
 def test_load_example_without_cv2(tmp_path, monkeypatch, rng):
     """The pipeline decodes via PIL + native when cv2 is unavailable."""
     from PIL import Image

@@ -38,12 +38,16 @@ def test_fused_metrics_match_reference_metrics():
     rng = np.random.RandomState(3)
     logits = jnp.asarray(rng.normal(0, 2, (2, 32, 32, 1)).astype(np.float32))
     masks = jnp.asarray((rng.uniform(size=(2, 32, 32, 1)) > 0.8).astype(np.float32))
-    ref = segmentation_metrics(logits, masks)
-    fused = fused_segmentation_metrics(logits, masks, impl="interpret")
-    for key in ref:
-        np.testing.assert_allclose(
-            float(fused[key]), float(ref[key]), rtol=1e-5, atol=1e-5, err_msg=key
+    for pw in (None, 4.0):
+        ref = segmentation_metrics(logits, masks, pos_weight=pw)
+        fused = fused_segmentation_metrics(
+            logits, masks, impl="interpret", pos_weight=pw
         )
+        for key in ref:
+            np.testing.assert_allclose(
+                float(fused[key]), float(ref[key]), rtol=1e-5, atol=1e-5,
+                err_msg=f"{key} pw={pw}",
+            )
 
 
 def test_gradient_matches_reference():

@@ -257,6 +257,57 @@ def test_chunked_log_upload_roundtrip(session_cfg, tmp_path):
         assert sink in p.parents  # no traversal out of the sink
 
 
+def test_corrupt_log_chunk_rejected(session_cfg, tmp_path):
+    """Integrity framing: a chunk whose declared CRC32C does not match its
+    bytes must be REJECTED (and never flushed), and the uploader must fail
+    loudly on the rejection. The reference shipped 100 MB chunks with no
+    checksums at all (fl_client.py:35-50)."""
+    import grpc
+
+    from fedcrack_tpu.native import crc32c
+    from fedcrack_tpu.transport import transport_pb2 as pb
+    from fedcrack_tpu.transport.service import METHOD, SERVICE_NAME
+
+    cfg = dataclasses.replace(
+        session_cfg, cohort_size=1, logs_dir=str(tmp_path / "sink")
+    )
+    server = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
+    with ServerThread(server) as st:
+        channel = grpc.insecure_channel(f"127.0.0.1:{st.port}")
+        method = channel.stream_stream(
+            f"/{SERVICE_NAME}/{METHOD}",
+            request_serializer=pb.ClientMessage.SerializeToString,
+            response_deserializer=pb.ServerMessage.FromString,
+        )
+
+        def call(msg):
+            return next(iter(method(iter([msg]), timeout=10, wait_for_ready=True)))
+
+        good = pb.ClientMessage(cname="a")
+        good.log.title = "m"
+        good.log.data = b"intact bytes"
+        good.log.offset = 0
+        good.log.crc32c = crc32c(b"intact bytes")
+        assert call(good).status == "OK"
+
+        bad = pb.ClientMessage(cname="a")
+        bad.log.title = "m"
+        bad.log.data = b"corrupted!!"
+        bad.log.offset = len(good.log.data)
+        bad.log.last = True
+        bad.log.crc32c = crc32c(b"what was sent")
+        rep = call(bad)
+        assert rep.status == R.REJECTED
+        assert "checksum mismatch" in rep.title
+        channel.close()
+        state = st.state
+
+    # nothing flushed (the rejected chunk was the flush trigger) and the
+    # buffer still holds only the verified bytes
+    assert not (tmp_path / "sink").exists() or not any((tmp_path / "sink").rglob("*"))
+    assert state.logs.get("a/m") == b"intact bytes"
+
+
 def test_server_side_eval_runs_per_round(session_cfg, tmp_path):
     """The reference designed per-round eval of the fresh global model but
     never enabled it (trainNextRound, fl_server.py:27-37); here it runs
