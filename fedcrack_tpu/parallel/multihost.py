@@ -42,8 +42,11 @@ def initialize_if_needed(
 
     Returns True when distributed mode was (already or newly) initialized.
     """
-    if jax.process_count() > 1:
-        return True  # already initialized
+    # NB: probed WITHOUT jax.process_count() — that call initializes the XLA
+    # backend, after which jax.distributed.initialize() unconditionally
+    # raises ("must be called before any JAX calls").
+    if jax.distributed.is_initialized():
+        return True
     env_addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
     if coordinator_address is None and env_addr:
         coordinator_address = env_addr

@@ -1,0 +1,160 @@
+"""Multi-host bring-up (`parallel/multihost.py`, SURVEY.md §5.8).
+
+Unit tests drive the resolution/error branches with a faked
+``jax.distributed``; the slow test is the real thing — two OS processes
+joined through ``jax.distributed.initialize`` over loopback (Gloo), with a
+cross-process psum over a 2-device mesh spanning both.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import jax
+import pytest
+
+from fedcrack_tpu.parallel.multihost import (
+    global_mesh_devices,
+    initialize_if_needed,
+    is_coordinator,
+)
+
+
+@pytest.fixture
+def not_initialized(monkeypatch):
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+
+
+def test_explicit_args_must_be_complete(not_initialized):
+    with pytest.raises(ValueError, match="together"):
+        initialize_if_needed("10.0.0.1:9999")
+    with pytest.raises(ValueError, match="together"):
+        initialize_if_needed("10.0.0.1:9999", num_processes=4)
+    with pytest.raises(ValueError, match="together"):
+        initialize_if_needed("10.0.0.1:9999", num_processes=4, process_id=-1)
+
+
+def test_env_var_resolution(not_initialized, monkeypatch):
+    calls = {}
+    monkeypatch.setattr(
+        jax.distributed, "initialize", lambda **kw: calls.update(kw)
+    )
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:9999")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    monkeypatch.setenv("JAX_PROCESS_ID", "2")
+    assert initialize_if_needed() is True
+    assert calls == {
+        "coordinator_address": "10.0.0.1:9999",
+        "num_processes": 4,
+        "process_id": 2,
+    }
+
+
+def test_env_var_incomplete_raises(not_initialized, monkeypatch):
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:9999")
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+    with pytest.raises(ValueError, match="together"):
+        initialize_if_needed()
+
+
+def test_autodetect_failure_means_single_host(not_initialized, monkeypatch):
+    def raise_value_error():
+        raise ValueError("no cluster metadata")
+
+    monkeypatch.setattr(jax.distributed, "initialize", raise_value_error)
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    assert initialize_if_needed() is False
+
+
+def test_already_initialized_short_circuits(monkeypatch):
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+
+    def boom(**kw):
+        raise AssertionError("initialize must not be called again")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    assert initialize_if_needed() is True
+    # and it must NOT touch jax.process_count() before deciding: doing so
+    # initializes the XLA backend, after which a real initialize() raises
+    # ("must be called before any JAX calls") — the bug that kept this
+    # module from ever running multi-process.
+
+
+def test_helpers_single_process():
+    assert is_coordinator()  # process 0 by convention
+    devs = global_mesh_devices()
+    assert devs == sorted(devs, key=lambda d: (d.process_index, d.id))
+    assert len(devs) == jax.device_count()
+
+
+_WORKER = """
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+sys.path.insert(0, {repo!r})
+from fedcrack_tpu.parallel.multihost import (
+    global_mesh_devices, initialize_if_needed, is_coordinator,
+)
+assert initialize_if_needed(f"127.0.0.1:{{port}}", n, pid)
+assert jax.process_count() == n, jax.process_count()
+assert is_coordinator() == (pid == 0)
+devs = global_mesh_devices()
+assert len(devs) == n, devs
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+mesh = Mesh(devs, ("clients",))
+def f(v):
+    return jax.lax.psum(v, "clients")
+y = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P(None), out_specs=P(None)))(
+    jnp.ones((1,), jnp.float32)
+)
+total = float(np.asarray(jax.device_get(y))[0])
+assert total == float(n), total
+print(f"OK pid={{pid}} psum={{total}}")
+"""
+
+
+@pytest.mark.slow
+def test_two_process_distributed_smoke(tmp_path):
+    """The real §5.8 capability check: 2 OS processes form one logical JAX
+    job (process_count()==2) and a psum crosses the process boundary."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER.format(repo=repo))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if not k.startswith(("JAX_", "XLA_", "PYTHONPATH"))
+    }
+    env["JAX_COMPILATION_CACHE_DIR"] = "/tmp/jax_cache"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid), "2", str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    finally:
+        for p in procs:  # never orphan a worker blocked in initialize()
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+    assert any("OK pid=0 psum=2.0" in o for o in outs), outs
+    assert any("OK pid=1 psum=2.0" in o for o in outs), outs
