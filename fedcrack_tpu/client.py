@@ -49,6 +49,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--predict-dir", help="write final-round mask predictions here")
     p.add_argument("--metrics", dest="metrics_path", help="JSONL metrics file")
     p.add_argument(
+        "--tb-dir",
+        dest="tb_dir",
+        help="TensorBoard event-file directory for per-round local-fit "
+        "scalars (the reference's TB callback, client_fit_model.py:153-154)",
+    )
+    p.add_argument(
         "--profile-dir",
         dest="profile_dir",
         help="jax.profiler trace dir wrapping each round's local fit",
@@ -66,6 +72,7 @@ def main(argv: list[str] | None = None) -> int:
             ("host", args.host),
             ("port", args.port),
             ("metrics_path", args.metrics_path),
+            ("tb_dir", args.tb_dir),
             ("profile_dir", args.profile_dir),
         ]
         if v is not None
@@ -150,10 +157,14 @@ def main(argv: list[str] | None = None) -> int:
         p.error(str(e))
 
     metrics_logger = None
-    if cfg.metrics_path:
+    if cfg.metrics_path or cfg.tb_dir:
+        import os
+
         from fedcrack_tpu.obs import MetricsLogger
 
-        metrics_logger = MetricsLogger(cfg.metrics_path)
+        metrics_logger = MetricsLogger(
+            cfg.metrics_path or os.devnull, tb_dir=cfg.tb_dir or None
+        )
     train_fn, holder = make_train_fn(
         cfg, dataset, batch, seed=args.seed, metrics_logger=metrics_logger
     )

@@ -116,6 +116,11 @@ class FedConfig:
     # JSONL structured-metrics file (per-round records, SURVEY.md §5.5);
     # empty disables.
     metrics_path: str = ""
+    # TensorBoard event-file directory: numeric per-round/epoch metrics are
+    # teed as real TB scalars (obs/tb.py, no TF dependency) — the
+    # reference's workflow of opening training logs in TensorBoard
+    # (client_fit_model.py:153-154). Empty disables.
+    tb_dir: str = ""
     # Server-side sink directory for client-uploaded log files (the
     # reference's 'L' chunk path wrote TensorBoard events under ./logs,
     # fl_server.py:84-89); empty keeps uploads in memory only.

@@ -14,5 +14,13 @@ from fedcrack_tpu.obs.metrics import (
     read_metrics,
     stopwatch,
 )
+from fedcrack_tpu.obs.tb import SummaryWriter, read_scalars
 
-__all__ = ["MetricsLogger", "profiler_trace", "read_metrics", "stopwatch"]
+__all__ = [
+    "MetricsLogger",
+    "SummaryWriter",
+    "profiler_trace",
+    "read_metrics",
+    "read_scalars",
+    "stopwatch",
+]

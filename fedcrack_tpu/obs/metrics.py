@@ -27,7 +27,12 @@ class MetricsLogger:
     (jax/numpy scalars) are coerced via ``float``/``int`` where possible.
     """
 
-    def __init__(self, path: str | os.PathLike | io.TextIOBase, echo=None):
+    def __init__(
+        self,
+        path: str | os.PathLike | io.TextIOBase,
+        echo=None,
+        tb_dir: str | os.PathLike | None = None,
+    ):
         if isinstance(path, io.TextIOBase):
             self._f = path
             self._owns = False
@@ -40,6 +45,15 @@ class MetricsLogger:
         self._echo = echo
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
+        # Optional TensorBoard tee (obs/tb.py): numeric fields of records
+        # that carry a `round`/`epoch` step become `kind/field` scalars in a
+        # real event file — the reference's "open it in TensorBoard"
+        # workflow (client_fit_model.py:153-154) next to the JSONL.
+        self._tb = None
+        if tb_dir:
+            from fedcrack_tpu.obs.tb import SummaryWriter
+
+            self._tb = SummaryWriter(tb_dir)
 
     def log(self, kind: str, **fields: Any) -> dict:
         record = {
@@ -53,6 +67,14 @@ class MetricsLogger:
         with self._lock:
             self._f.write(line + "\n")
             self._f.flush()
+        if self._tb is not None:
+            step = record.get("round", record.get("epoch"))
+            if isinstance(step, int) and not isinstance(step, bool):
+                for k, v in record.items():
+                    if k in ("kind", "t", "ts", "round", "epoch"):
+                        continue
+                    if isinstance(v, (int, float)) and not isinstance(v, bool):
+                        self._tb.add_scalar(f"{kind}/{k}", float(v), step)
         if self._echo is not None:
             self._echo(line)
         return record
@@ -61,6 +83,8 @@ class MetricsLogger:
         if self._owns:
             with self._lock:
                 self._f.close()
+        if self._tb is not None:
+            self._tb.close()
 
     def __enter__(self) -> "MetricsLogger":
         return self

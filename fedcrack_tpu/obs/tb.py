@@ -1,0 +1,215 @@
+"""TensorBoard scalar event files, written without TensorFlow.
+
+The reference emits real TB event files every round via the Keras callback
+(reference: client_fit_model.py:153-154) so a human can point TensorBoard at
+the log directory. The JSONL metrics sink (obs/metrics.py) is this repo's
+structured record of truth, but it is not TB-readable; this module restores
+the "open it in TensorBoard" workflow with a ~100-line writer that speaks
+the TFRecord + Event-proto wire format directly — no tensorflow import on
+the production path (TF is a test-only cross-check here).
+
+Format notes (stable since TF 1.x, verified against TensorBoard 2.20's
+event_accumulator in tests):
+
+- A file is a sequence of TFRecords: ``uint64 len | uint32 masked_crc(len)
+  | data | uint32 masked_crc(data)``, CRC32C (Castagnoli) with TF's mask
+  ``((crc >> 15 | crc << 17) + 0xa282ead8)``. The native runtime's hardware
+  CRC32C (fedcrack_tpu.native) does the checksumming.
+- Each record is a serialized ``Event`` proto; scalars ride
+  ``Event{wall_time(1:double), step(2:int64), summary(5){value(1){
+  tag(1:string), simple_value(2:float)}}}``, hand-encoded below (the
+  message is tiny and frozen — a protobuf dependency would be overkill).
+- The first record is ``Event{wall_time, file_version="brain.Event:2"}``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import socket
+import struct
+import threading
+import time
+
+from fedcrack_tpu.native import crc32c
+
+_MASK_DELTA = 0xA282EAD8
+# Filename uniquifier: same-second writers on one host (e.g. a co-located
+# server and client both pointed at the same --tb-dir) must never append
+# into one file — interleaved records corrupt each other's CRC framing.
+_FILE_COUNTER = itertools.count()
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + _MASK_DELTA & 0xFFFFFFFF
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        bits = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return bytes(out)
+
+
+def _field_bytes(number: int, payload: bytes) -> bytes:
+    return _varint((number << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _field_double(number: int, value: float) -> bytes:
+    return _varint((number << 3) | 1) + struct.pack("<d", value)
+
+
+def _field_float(number: int, value: float) -> bytes:
+    return _varint((number << 3) | 5) + struct.pack("<f", value)
+
+
+def _field_varint(number: int, value: int) -> bytes:
+    return _varint(number << 3) + _varint(value & 0xFFFFFFFFFFFFFFFF)
+
+
+def _scalar_event(tag: str, value: float, step: int, wall_time: float) -> bytes:
+    summary_value = (
+        _field_bytes(1, tag.encode("utf-8")) + _field_float(2, float(value))
+    )
+    summary = _field_bytes(1, summary_value)
+    return (
+        _field_double(1, wall_time)
+        + _field_varint(2, int(step))
+        + _field_bytes(5, summary)
+    )
+
+
+def _version_event(wall_time: float) -> bytes:
+    return _field_double(1, wall_time) + _field_bytes(3, b"brain.Event:2")
+
+
+class SummaryWriter:
+    """Append-only TB scalar writer; thread-safe, one event file per logdir.
+
+    ``SummaryWriter(d).add_scalar("round/loss", 0.12, step=3)`` produces a
+    file TensorBoard's scalars dashboard loads directly.
+    """
+
+    def __init__(self, logdir: str | os.PathLike):
+        logdir = os.fspath(logdir)
+        os.makedirs(logdir, exist_ok=True)
+        name = (
+            f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
+            f".{os.getpid()}.{next(_FILE_COUNTER)}"
+        )
+        self.path = os.path.join(logdir, name)
+        self._f = open(self.path, "ab")
+        self._lock = threading.Lock()
+        self._write(_version_event(time.time()))
+
+    def _write(self, event: bytes) -> None:
+        header = struct.pack("<Q", len(event))
+        record = (
+            header
+            + struct.pack("<I", _masked_crc(header))
+            + event
+            + struct.pack("<I", _masked_crc(event))
+        )
+        with self._lock:
+            self._f.write(record)
+            self._f.flush()
+
+    def add_scalar(
+        self, tag: str, value: float, step: int, wall_time: float | None = None
+    ) -> None:
+        self._write(
+            _scalar_event(tag, value, step, wall_time or time.time())
+        )
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+    def __enter__(self) -> "SummaryWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_scalars(path: str | os.PathLike) -> list[tuple[str, float, int]]:
+    """Minimal event-file reader: ``[(tag, value, step), ...]`` — the
+    self-contained round-trip oracle (tests also cross-check with the real
+    TensorBoard event_accumulator). Verifies record CRCs."""
+    out = []
+    with open(os.fspath(path), "rb") as f:
+        data = f.read()
+    pos = 0
+    while pos < len(data):
+        (length,) = struct.unpack_from("<Q", data, pos)
+        header = data[pos : pos + 8]
+        (len_crc,) = struct.unpack_from("<I", data, pos + 8)
+        if _masked_crc(header) != len_crc:
+            raise ValueError(f"corrupt length CRC at byte {pos}")
+        event = data[pos + 12 : pos + 12 + length]
+        (data_crc,) = struct.unpack_from("<I", data, pos + 12 + length)
+        if _masked_crc(event) != data_crc:
+            raise ValueError(f"corrupt event CRC at byte {pos}")
+        pos += 12 + length + 4
+        out.extend(_parse_event(event))
+    return out
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _parse_fields(buf: bytes):
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        number, wire = key >> 3, key & 7
+        if wire == 0:
+            value, pos = _read_varint(buf, pos)
+        elif wire == 1:
+            value = buf[pos : pos + 8]
+            pos += 8
+        elif wire == 2:
+            size, pos = _read_varint(buf, pos)
+            value = buf[pos : pos + size]
+            pos += size
+        elif wire == 5:
+            value = buf[pos : pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield number, wire, value
+
+
+def _parse_event(event: bytes) -> list[tuple[str, float, int]]:
+    step = 0
+    scalars = []
+    for number, wire, value in _parse_fields(event):
+        if number == 2 and wire == 0:
+            step = value
+        elif number == 5 and wire == 2:  # summary
+            for n2, w2, v2 in _parse_fields(value):
+                if n2 == 1 and w2 == 2:  # Summary.Value
+                    tag, val = "", None
+                    for n3, w3, v3 in _parse_fields(v2):
+                        if n3 == 1 and w3 == 2:
+                            tag = v3.decode("utf-8")
+                        elif n3 == 2 and w3 == 5:
+                            (val,) = struct.unpack("<f", v3)
+                    if val is not None:
+                        scalars.append((tag, val, step))
+    return [(t, v, step) for t, v, _ in scalars]

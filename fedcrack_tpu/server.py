@@ -11,6 +11,7 @@ import argparse
 import asyncio
 import json
 import logging
+import os
 import sys
 from typing import Any
 
@@ -65,6 +66,13 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         help="JSONL file for structured per-round metrics (SURVEY.md §5.5)",
     )
     p.add_argument(
+        "--tb-dir",
+        dest="tb_dir",
+        help="TensorBoard event-file directory: per-round metrics become "
+        "real TB scalars (the reference's TensorBoard workflow, "
+        "client_fit_model.py:153-154)",
+    )
+    p.add_argument(
         "--eval-synthetic",
         type=int,
         default=0,
@@ -110,6 +118,7 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         ("ckpt_dir", "ckpt_dir"),
         ("seed", "seed"),
         ("metrics_path", "metrics_path"),
+        ("tb_dir", "tb_dir"),
         ("logs_dir", "logs_dir"),
         ("init_weights", "init_weights"),
     ]:
@@ -172,10 +181,12 @@ def main(argv: list[str] | None = None) -> int:
 
         checkpointer = FedCheckpointer(cfg.ckpt_dir)
     metrics = None
-    if cfg.metrics_path:
+    if cfg.metrics_path or cfg.tb_dir:
         from fedcrack_tpu.obs import MetricsLogger
 
-        metrics = MetricsLogger(cfg.metrics_path)
+        metrics = MetricsLogger(
+            cfg.metrics_path or os.devnull, tb_dir=cfg.tb_dir or None
+        )
     server = FedServer(
         cfg, variables, checkpointer=checkpointer, metrics=metrics, eval_fn=eval_fn
     )

@@ -116,3 +116,74 @@ def test_round_history_carries_wall_clock_and_bytes():
     assert entry["bytes_broadcast"] > 0
     # history entries are JSON-serializable (checkpoint meta requirement)
     json.dumps(entry)
+
+
+# ---- TensorBoard event-file export (obs/tb.py) ----
+
+
+def test_tb_writer_roundtrip_and_crc(tmp_path):
+    from fedcrack_tpu.obs import SummaryWriter, read_scalars
+
+    with SummaryWriter(tmp_path) as w:
+        w.add_scalar("round/loss", 0.5, step=1)
+        w.add_scalar("round/loss", 0.25, step=2)
+        w.add_scalar("round/iou", 0.75, step=2)
+        path = w.path
+    got = read_scalars(path)
+    assert got == [
+        ("round/loss", 0.5, 1),
+        ("round/loss", 0.25, 2),
+        ("round/iou", 0.75, 2),
+    ]
+    # a flipped byte in any record must be detected, not silently parsed
+    import pytest as _pytest
+
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    bad = tmp_path / "corrupt" / "events.out.tfevents.0.x"
+    bad.parent.mkdir()
+    bad.write_bytes(bytes(blob))
+    with _pytest.raises(ValueError, match="CRC"):
+        read_scalars(bad)
+
+
+def test_tb_file_loads_in_real_tensorboard(tmp_path):
+    """The acceptance bar: TensorBoard itself (event_accumulator) must read
+    our hand-encoded event file — tags, values, steps."""
+    from fedcrack_tpu.obs import SummaryWriter
+
+    with SummaryWriter(tmp_path) as w:
+        for step, loss in enumerate([0.9, 0.5, 0.3], start=1):
+            w.add_scalar("round/loss", loss, step=step)
+        w.add_scalar("round/iou", 0.42, step=3)
+
+    from tensorboard.backend.event_processing import event_accumulator
+
+    acc = event_accumulator.EventAccumulator(str(tmp_path))
+    acc.Reload()
+    assert set(acc.Tags()["scalars"]) == {"round/loss", "round/iou"}
+    losses = acc.Scalars("round/loss")
+    assert [e.step for e in losses] == [1, 2, 3]
+    np.testing.assert_allclose([e.value for e in losses], [0.9, 0.5, 0.3], rtol=1e-6)
+    (iou,) = acc.Scalars("round/iou")
+    assert iou.step == 3 and abs(iou.value - 0.42) < 1e-6
+
+
+def test_metrics_logger_tees_tb_scalars(tmp_path):
+    from fedcrack_tpu.obs import MetricsLogger, read_scalars
+
+    tb_dir = tmp_path / "tb"
+    with MetricsLogger(tmp_path / "m.jsonl", tb_dir=tb_dir) as m:
+        m.log("round", round=1, loss=0.5, iou=0.1, clients=["a"], note="x")
+        m.log("round", round=2, loss=0.25, iou=0.3)
+        m.log("session", enrolled=True)  # no step field -> no scalars
+    (event_file,) = list(tb_dir.iterdir())
+    got = read_scalars(event_file)
+    by_key = {(tag, step): value for tag, value, step in got}
+    assert by_key[("round/loss", 1)] == 0.5
+    assert abs(by_key[("round/iou", 2)] - 0.3) < 1e-6  # float32 storage
+    # non-numeric fields and step-less records never become scalars
+    assert not [t for t, _, _ in got if "clients" in t or "note" in t]
+    assert not [t for t, _, _ in got if t.startswith("session/")]
+    # the JSONL record of truth is untouched by the tee
+    assert len(read_metrics(tmp_path / "m.jsonl", "round")) == 2
