@@ -8,6 +8,12 @@ wall-clock, and bytes moved on the control plane — and ``jax.profiler``
 traces can wrap any training span for TPU timeline inspection.
 """
 
+from fedcrack_tpu.obs.flops import (
+    device_peak_flops,
+    mfu,
+    resunet_forward_flops,
+    train_step_flops,
+)
 from fedcrack_tpu.obs.metrics import (
     MetricsLogger,
     profiler_trace,
@@ -19,8 +25,12 @@ from fedcrack_tpu.obs.tb import SummaryWriter, read_scalars
 __all__ = [
     "MetricsLogger",
     "SummaryWriter",
+    "device_peak_flops",
+    "mfu",
     "profiler_trace",
     "read_metrics",
     "read_scalars",
+    "resunet_forward_flops",
     "stopwatch",
+    "train_step_flops",
 ]

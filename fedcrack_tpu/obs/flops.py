@@ -1,0 +1,120 @@
+"""Analytic FLOPs model of the crack U-Net + MFU accounting.
+
+Round 1 measured wall-clock only; a per-step time is uninterpretable without
+knowing how much of the chip's peak it represents. This module walks the exact
+topology of SURVEY.md §2.3 (reference: client_fit_model.py:92-150) and counts
+matmul-equivalent FLOPs — the convolutions, which carry >99% of the arithmetic
+and are the only ops that land on the MXU. Elementwise work (BN, ReLU,
+residual adds, sigmoid/loss) is O(HW·C) against the convs' O(HW·C²·K²) and is
+deliberately excluded; the analytic total is cross-checked against XLA's own
+HLO cost analysis in tests/test_flops.py.
+
+MFU is reported against the chip's **bf16 MXU peak** for both dtypes (the
+standard convention — float32 runs the same systolic array via multi-pass,
+so "fraction of the machine's ceiling" stays comparable across dtypes).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from fedcrack_tpu.configs import ModelConfig
+
+# One SGD step ≈ forward + backward; for conv stacks the backward pass is two
+# conv-shaped passes (grad wrt activations + grad wrt kernels), so train-step
+# FLOPs ≈ 3x forward. Optimizer/BN/loss work is elementwise and excluded.
+TRAIN_STEP_FLOPS_MULTIPLIER = 3.0
+
+# Per-chip dense peak (TFLOP/s, bf16 on the MXU), keyed by substrings of
+# jax.Device.device_kind. Override with FEDCRACK_PEAK_TFLOPS for kinds not
+# listed (e.g. new hardware or a tunnel that reports an opaque kind).
+_PEAK_TFLOPS_BF16 = (
+    ("v6e", 918.0),
+    ("v6 lite", 918.0),
+    ("v5p", 459.0),
+    ("v5e", 197.0),
+    ("v5 lite", 197.0),
+    ("v5lite", 197.0),
+    ("v4i", 138.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+)
+
+
+def _conv_flops(out_hw: int, c_in: int, c_out: int, k: int) -> float:
+    """Dense KxK conv at SAME padding: 2 FLOPs (mul+add) per MAC."""
+    return 2.0 * out_hw * out_hw * c_out * (k * k * c_in)
+
+
+def resunet_forward_flops(config: ModelConfig | None = None, batch_size: int = 1) -> float:
+    """Forward-pass FLOPs for one batch through the residual U-Net.
+
+    Mirrors models/resunet.py layer by layer: stem conv /2; encoder blocks
+    (depthwise 3x3 + pointwise 1x1) x2 + pool /2 + strided 1x1 residual;
+    decoder blocks (3x3 transpose-conv, stride 1 == plain conv) x2 +
+    upsample x2 + upsampled 1x1 residual; 1x1 head.
+    """
+    cfg = config or ModelConfig()
+    s = cfg.img_size // 2  # after the stride-2 stem
+    c = cfg.stem_features
+    total = _conv_flops(s, cfg.in_channels, c, 3)
+
+    for feat in cfg.encoder_features:
+        # SeparableConv = depthwise 3x3 (per-channel) + pointwise 1x1.
+        total += 2.0 * s * s * c * 9  # depthwise on c channels
+        total += _conv_flops(s, c, feat, 1)  # pointwise c -> feat
+        total += 2.0 * s * s * feat * 9
+        total += _conv_flops(s, feat, feat, 1)
+        s //= 2  # MaxPool(3x3, stride 2)
+        # Residual: 1x1 stride-2 conv from the block input (c channels).
+        total += _conv_flops(s, c, feat, 1)
+        c = feat
+
+    for feat in cfg.decoder_features:
+        # Stride-1 ConvTranspose(3x3, SAME) costs the same as a 3x3 conv.
+        total += _conv_flops(s, c, feat, 3)
+        total += _conv_flops(s, feat, feat, 3)
+        s *= 2  # UpSampling2D(2)
+        # Residual: upsample block input then 1x1 conv at the new resolution.
+        total += _conv_flops(s, c, feat, 1)
+        c = feat
+
+    total += _conv_flops(s, c, cfg.num_classes, 1)  # sigmoid head (s == img_size)
+    return total * float(batch_size)
+
+
+def train_step_flops(config: ModelConfig | None = None, batch_size: int = 1) -> float:
+    """FLOPs for one SGD step (forward + backward) at the given batch size."""
+    return TRAIN_STEP_FLOPS_MULTIPLIER * resunet_forward_flops(config, batch_size)
+
+
+def device_peak_flops(device: jax.Device | None = None) -> float | None:
+    """Per-chip bf16 dense peak in FLOP/s, or None when the kind is unknown.
+
+    ``FEDCRACK_PEAK_TFLOPS`` overrides (useful behind device tunnels whose
+    ``device_kind`` string is opaque).
+    """
+    env = os.environ.get("FEDCRACK_PEAK_TFLOPS", "")
+    if env:
+        return float(env) * 1e12
+    if device is None:
+        device = jax.devices()[0]
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    for needle, tflops in _PEAK_TFLOPS_BF16:
+        if needle in kind:
+            return tflops * 1e12
+    return None
+
+
+def mfu(step_time_s: float, flops_per_step: float, device: jax.Device | None = None) -> float | None:
+    """Model FLOPs utilization: achieved FLOP/s over the chip's bf16 peak.
+
+    None when the peak is unknown (non-TPU host, unrecognized device kind).
+    """
+    peak = device_peak_flops(device)
+    if peak is None or step_time_s <= 0.0:
+        return None
+    return (flops_per_step / step_time_s) / peak

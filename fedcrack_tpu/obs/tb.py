@@ -124,7 +124,9 @@ class SummaryWriter:
         self, tag: str, value: float, step: int, wall_time: float | None = None
     ) -> None:
         self._write(
-            _scalar_event(tag, value, step, wall_time or time.time())
+            _scalar_event(
+                tag, value, step, time.time() if wall_time is None else wall_time
+            )
         )
 
     def close(self) -> None:

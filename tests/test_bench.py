@@ -1,0 +1,61 @@
+"""bench.py must actually run, end to end — round 1's lesson is that code
+that only ever executes on the driver's hardware is code that silently rots.
+The smoke run uses tiny env knobs and the CPU backend; it checks the JSON
+contract the driver parses, not performance."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_bench_smoke_emits_driver_contract():
+    env = dict(os.environ)
+    env.update(
+        FEDCRACK_BENCH_FORCE_CPU="1",
+        FEDCRACK_BENCH_STEPS="2",
+        FEDCRACK_BENCH_BATCH="4",
+        FEDCRACK_BENCH_REPS="1",
+        FEDCRACK_BENCH_SIZES="32",
+    )
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py")],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=900,
+        cwd=root,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+
+    # The driver's contract: one JSON line with these keys.
+    assert set(out) >= {"metric", "value", "unit", "vs_baseline"}
+    assert out["unit"] == "ms"
+    assert out["value"] > 0
+    assert out["vs_baseline"] > 0
+
+    # The round-2 additions: full sweep + decomposed host plane.
+    detail = out["detail"]
+    assert set(detail["sweep"]) == {"float32_32", "bfloat16_32"}
+    for point in detail["sweep"].values():
+        assert point["per_step_ms"] > 0
+        assert point["flops_per_step"] > 0
+    host = detail["host_plane"]
+    reconstructed = (
+        detail["n_clients"] * detail["steps"] * host["per_step_compute_ms"]
+        + host["serialization_ms"]
+        + host["host_fedavg_ms"]
+        + host["dispatch_overhead_ms"]
+    )
+    # The decomposition must account for the measured total: dispatch is the
+    # max(0, residual), so the parts either sum to the total (residual
+    # positive) or over-cover it (compute estimate overshot a tiny CPU run —
+    # they can never under-explain the round).
+    assert reconstructed >= host["round_ms"] * 0.98
+    assert detail["vs_baseline_compute_only"] > 0

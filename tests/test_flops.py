@@ -1,0 +1,69 @@
+"""The analytic FLOPs model must agree with XLA's own HLO cost analysis —
+otherwise every MFU number built on it is fiction."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fedcrack_tpu.configs import ModelConfig
+from fedcrack_tpu.models import ResUNet
+from fedcrack_tpu.obs.flops import (
+    TRAIN_STEP_FLOPS_MULTIPLIER,
+    device_peak_flops,
+    mfu,
+    resunet_forward_flops,
+    train_step_flops,
+)
+
+
+def test_forward_flops_match_xla_cost_analysis():
+    # Flagship shape (convs dominate; at tiny shapes XLA's accounting of
+    # padding/transpose-conv edges diverges more).
+    cfg = ModelConfig()
+    model = ResUNet(config=cfg)
+    variables = model.init(
+        jax.random.key(0), jnp.zeros((1, *cfg.input_shape)), train=False
+    )
+    batch = 4
+    images = jnp.zeros((batch, *cfg.input_shape))
+
+    def fwd(v, x):
+        return model.apply(v, x, train=False)
+
+    analysis = jax.jit(fwd).lower(variables, images).compile().cost_analysis()
+    if isinstance(analysis, list):
+        analysis = analysis[0]
+    xla_flops = float(analysis["flops"])
+    analytic = resunet_forward_flops(cfg, batch)
+    assert 0.75 * xla_flops <= analytic <= 1.25 * xla_flops, (
+        f"analytic {analytic:.3e} vs XLA {xla_flops:.3e}"
+    )
+
+
+def test_flops_scale_with_resolution_and_batch():
+    f128 = resunet_forward_flops(ModelConfig(img_size=128))
+    f256 = resunet_forward_flops(ModelConfig(img_size=256))
+    # Fully convolutional: 4x the pixels is 4x the FLOPs, exactly.
+    assert f256 == pytest.approx(4.0 * f128)
+    assert resunet_forward_flops(ModelConfig(), batch_size=16) == pytest.approx(
+        16.0 * f128
+    )
+
+
+def test_train_step_is_forward_times_multiplier():
+    cfg = ModelConfig(img_size=32)
+    assert train_step_flops(cfg, 8) == pytest.approx(
+        TRAIN_STEP_FLOPS_MULTIPLIER * resunet_forward_flops(cfg, 8)
+    )
+
+
+def test_peak_flops_env_override_and_unknown_kind(monkeypatch):
+    monkeypatch.setenv("FEDCRACK_PEAK_TFLOPS", "197")
+    assert device_peak_flops() == pytest.approx(197e12)
+    assert mfu(step_time_s=0.010, flops_per_step=197e12 * 0.010 * 0.5) == pytest.approx(
+        0.5
+    )
+    monkeypatch.delenv("FEDCRACK_PEAK_TFLOPS")
+    # The CPU test backend has no known MXU peak: MFU must be None, not a lie.
+    assert device_peak_flops() is None
+    assert mfu(0.010, 1e9) is None
