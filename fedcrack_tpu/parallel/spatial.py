@@ -252,9 +252,12 @@ def spatial_apply(
         x = jax.nn.relu(x)
         x = _conv3x3_s1(x, p[f"dec{i}_convT2"], axis_name, axis_size)
         x = apply_bn(x, f"dec{i}_bn2")
-        x = upsample2x(x)
-        residual = _conv1x1(upsample2x(previous), p[f"dec{i}_res"])
-        x = x + residual
+        # Same algebraic fusion as models/resunet.py: the 1x1 residual conv
+        # commutes with nearest upsampling, so conv + add happen pre-upsample
+        # and one broadcast replaces two (also halves the halo shard's HBM
+        # traffic here).
+        residual = _conv1x1(previous, p[f"dec{i}_res"])
+        x = upsample2x(x + residual)
         previous = x
 
     logits = _conv1x1(x.astype(jnp.float32), jax.tree_util.tree_map(

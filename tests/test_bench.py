@@ -44,7 +44,9 @@ def test_bench_smoke_emits_driver_contract():
     detail = out["detail"]
     assert set(detail["sweep"]) == {"float32_32", "bfloat16_32"}
     for point in detail["sweep"].values():
-        assert point["per_step_ms"] > 0
+        # Slope-based per-step can be None when the two-point fit fails on a
+        # noisy host; the naive fallback must always be there.
+        assert (point["per_step_ms"] or point["naive_per_step_ms"]) > 0
         assert point["flops_per_step"] > 0
     host = detail["host_plane"]
     reconstructed = (
