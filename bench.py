@@ -289,8 +289,15 @@ def main() -> None:
         "vs_baseline_compute_only": round(compute_only_s / mesh_f32_compute_s, 3),
         # Measured end-to-end ratio against the bf16 flagship.
         "vs_baseline_vs_flagship": round(host_total_s / mesh_bf16_s, 3),
-        # From slopes, so the dispatch intercept doesn't dilute the dtype win.
-        "bf16_speedup_over_f32": round(mesh_f32_compute_s / mesh_bf16_compute_s, 3),
+        # From slopes, so the dispatch intercept doesn't dilute the dtype win;
+        # None unless BOTH fits succeeded (mixing a dispatch-inflated naive
+        # fallback on one side only would fabricate a speedup).
+        "bf16_speedup_over_f32": (
+            round(mesh_f32_compute_s / mesh_bf16_compute_s, 3)
+            if sweep[f32_key]["per_step_ms"] is not None
+            and sweep[bf16_key]["per_step_ms"] is not None
+            else None
+        ),
         "device_kind": getattr(device, "device_kind", "unknown"),
         "peak_tflops_bf16": None if peak is None else peak / 1e12,
         "n_clients": n_clients,

@@ -53,6 +53,16 @@ def _masked_mean_over_clients(tree: Any, weight: jax.Array, denom: jax.Array) ->
     return jax.tree_util.tree_map(leaf, tree)
 
 
+def _host_view(x) -> np.ndarray | None:
+    """Host-fetchable float32 view of a cohort mask/weight vector, or None
+    when ``x`` is a cross-process sharded jax.Array whose global value this
+    process cannot fetch (multi-host jobs — the in-mesh empty-cohort guard
+    covers that case)."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        return None
+    return np.asarray(x, np.float32)
+
+
 def _require_axes(mesh: Mesh, *axes: str) -> None:
     missing = [a for a in axes if a not in mesh.shape]
     if missing:
@@ -168,11 +178,23 @@ def _build_round(
 
         # Masked sample-weighted FedAvg over the clients axis (ICI psum).
         w = active_i * n_i
-        denom = jnp.maximum(lax.psum(w, CLIENTS), 1e-9)
-        new_variables = {
+        total_w = lax.psum(w, CLIENTS)
+        denom = jnp.maximum(total_w, 1e-9)
+        averaged = {
             "params": _masked_mean_over_clients(params, w, denom),
             "batch_stats": _masked_mean_over_clients(batch_stats, w, denom),
         }
+        # Empty-cohort guard, enforced IN-MESH: when every client dropped out
+        # the masked mean above is all-zeros — return the round's incoming
+        # global model unchanged instead. The host-side ValueError still fires
+        # where the mask is host-visible; this covers multi-host jobs whose
+        # sharded mask no single process can inspect.
+        keep = total_w > 0.0
+        new_variables = jax.tree_util.tree_map(
+            lambda avg, orig: jnp.where(keep, avg, orig.astype(avg.dtype)),
+            averaged,
+            {"params": anchor, "batch_stats": variables["batch_stats"]},
+        )
 
         last = jax.tree_util.tree_map(lambda a: a[-1], per_epoch)
         metrics = {
@@ -200,15 +222,22 @@ def _build_round(
                 f"{n_client_shards} on the '{CLIENTS}' axis"
             )
         validate_data(images)
-        active = np.asarray(active, np.float32)
-        n_samples = np.asarray(n_samples, np.float32)
+
         # Same contract as fed.algorithms.fedavg: an empty effective cohort
-        # is an error, never a silently-zeroed global model.
-        if float(np.sum(active * n_samples)) <= 0.0:
-            raise ValueError(
-                "non-positive total FedAvg weight: every client dropped out "
-                f"(active={active.tolist()}, n_samples={n_samples.tolist()})"
-            )
+        # is an error, never a silently-zeroed global model. In a multi-host
+        # job the mask arrives as a cross-process sharded jax.Array whose
+        # global value THIS process cannot fetch — the check then happens
+        # in-mesh instead (all-dropout returns the incoming global model
+        # unchanged; see the `keep` guard in client_fit).
+        active_h, n_samples_h = _host_view(active), _host_view(n_samples)
+        if active_h is not None and n_samples_h is not None:
+            if float(np.sum(active_h * n_samples_h)) <= 0.0:
+                raise ValueError(
+                    "non-positive total FedAvg weight: every client dropped "
+                    f"out (active={active_h.tolist()}, "
+                    f"n_samples={n_samples_h.tolist()})"
+                )
+            active, n_samples = active_h, n_samples_h
         return jitted(variables, images, masks, active, n_samples)
 
     return round_fn

@@ -12,6 +12,13 @@ slices. The gRPC control plane remains for cross-trust-boundary federation
 
 Single-process usage (tests, one chip, CPU meshes) needs no initialization —
 every helper here degrades to a no-op.
+
+The round builders accept cross-process inputs directly: stage each
+process's client shards with ``jax.make_array_from_process_local_data`` over
+the global mesh and call ``build_federated_round``'s round_fn unchanged —
+``tests/test_multihost.py::test_two_process_federated_round`` runs one
+FedAvg round across two OS processes and pins bit-equality of the resulting
+global model against the single-process round.
 """
 
 from __future__ import annotations

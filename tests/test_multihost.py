@@ -118,13 +118,14 @@ print(f"OK pid={{pid}} psum={{total}}")
 """
 
 
-@pytest.mark.slow
-def test_two_process_distributed_smoke(tmp_path):
-    """The real §5.8 capability check: 2 OS processes form one logical JAX
-    job (process_count()==2) and a psum crosses the process boundary."""
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+def _launch_two_workers(script_text: str, tmp_path, timeout: float) -> list[str]:
+    """Run the worker script as 2 coordinated OS processes over a free
+    loopback port; return their outputs. Encodes the hard-won launch rules:
+    strip every JAX_/XLA_/PYTHONPATH env var (the image profile pre-binds the
+    axon TPU platform), share the compilation cache, and never orphan a
+    worker blocked in jax.distributed.initialize()."""
     script = tmp_path / "worker.py"
-    script.write_text(_WORKER.format(repo=repo))
+    script.write_text(script_text)
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -147,14 +148,112 @@ def test_two_process_distributed_smoke(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=180)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     finally:
-        for p in procs:  # never orphan a worker blocked in initialize()
+        for p in procs:
             if p.poll() is None:
                 p.kill()
                 p.wait()
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out
+    return outs
+
+
+_ROUND_WORKER = """
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+sys.path.insert(0, {repo!r})
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from fedcrack_tpu.configs import ModelConfig
+from fedcrack_tpu.data.synthetic import synth_crack_batch
+from fedcrack_tpu.parallel import build_federated_round, stack_client_data
+from fedcrack_tpu.parallel.multihost import global_mesh_devices, initialize_if_needed
+from fedcrack_tpu.train.local import create_train_state
+
+assert initialize_if_needed(f"127.0.0.1:{{port}}", n, pid)
+assert jax.device_count() == 4 * n
+devs = global_mesh_devices()
+mesh = Mesh(np.asarray(devs, dtype=object).reshape(2 * n, 2), ("clients", "batch"))
+tiny = ModelConfig(img_size=16, stem_features=4, encoder_features=(8,),
+                   decoder_features=(8, 4))
+steps, batch = 2, 4
+# Each process synthesizes only ITS clients' shards (client index = global).
+local = [synth_crack_batch(steps * batch, img_size=16, seed=c)
+         for c in (2 * pid, 2 * pid + 1)]
+li, lm = stack_client_data(local, steps, batch)
+data_sharding = NamedSharding(mesh, P("clients", None, "batch"))
+images = jax.make_array_from_process_local_data(data_sharding, li)
+masks = jax.make_array_from_process_local_data(data_sharding, lm)
+variables = jax.device_put(create_train_state(jax.random.key(0), tiny).variables,
+                           NamedSharding(mesh, P()))
+cshard = NamedSharding(mesh, P("clients"))
+active = jax.device_put(np.ones(2 * n, np.float32), cshard)
+n_samples = jax.device_put(np.full(2 * n, float(steps * batch), np.float32), cshard)
+round_fn = build_federated_round(mesh, tiny, learning_rate=1e-3, local_epochs=1)
+new_vars, metrics = round_fn(variables, images, masks, active, n_samples)
+jax.block_until_ready(new_vars)
+local_losses = np.asarray(metrics["loss"].addressable_shards[0].data)
+assert np.all(np.isfinite(local_losses)), local_losses
+leaf = jax.tree_util.tree_leaves(new_vars["params"])[1]
+leafsum = float(np.asarray(leaf.addressable_shards[0].data, np.float64).sum())
+print(f"OK pid={{pid}} leafsum={{leafsum:.9e}}")
+"""
+
+
+@pytest.mark.slow
+def test_two_process_federated_round(tmp_path):
+    """The full §5.8 capability: ONE federated round (4 clients x 2-way
+    intra-client DP over 8 devices) spanning TWO OS processes — the FedAvg
+    psum crosses the process boundary, each process stages only its own
+    clients' data, and the resulting global model is identical on every
+    process AND identical to the same round run single-process."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outs = _launch_two_workers(_ROUND_WORKER.format(repo=repo), tmp_path, timeout=300)
+    sums = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("OK pid="):
+                pid = int(line.split("pid=")[1].split()[0])
+                sums[pid] = float(line.split("leafsum=")[1])
+    assert set(sums) == {0, 1}, outs
+    # psum-FedAvg must leave every process with the identical global model.
+    assert sums[0] == sums[1], sums
+
+    # Golden cross-check: the same round on this process's own 8-device mesh.
+    import numpy as np
+
+    from fedcrack_tpu.configs import ModelConfig
+    from fedcrack_tpu.data.synthetic import synth_crack_batch
+    from fedcrack_tpu.parallel import build_federated_round, make_mesh, stack_client_data
+    from fedcrack_tpu.train.local import create_train_state
+
+    tiny = ModelConfig(
+        img_size=16, stem_features=4, encoder_features=(8,), decoder_features=(8, 4)
+    )
+    steps, batch = 2, 4
+    per_client = [synth_crack_batch(steps * batch, img_size=16, seed=c) for c in range(4)]
+    images, masks = stack_client_data(per_client, steps, batch)
+    variables = create_train_state(jax.random.key(0), tiny).variables
+    round_fn = build_federated_round(make_mesh(4, 2), tiny, learning_rate=1e-3, local_epochs=1)
+    new_vars, _ = round_fn(
+        variables, images, masks, np.ones(4, np.float32),
+        np.full(4, float(steps * batch), np.float32),
+    )
+    leaf = jax.tree_util.tree_leaves(new_vars["params"])[1]
+    golden = float(np.asarray(leaf, np.float64).sum())
+    assert sums[0] == pytest.approx(golden, rel=1e-5)
+
+
+@pytest.mark.slow
+def test_two_process_distributed_smoke(tmp_path):
+    """The real §5.8 capability check: 2 OS processes form one logical JAX
+    job (process_count()==2) and a psum crosses the process boundary."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outs = _launch_two_workers(_WORKER.format(repo=repo), tmp_path, timeout=180)
     assert any("OK pid=0 psum=2.0" in o for o in outs), outs
     assert any("OK pid=1 psum=2.0" in o for o in outs), outs

@@ -206,6 +206,27 @@ class TestMeshMatchesHost:
         with pytest.raises(ValueError, match="non-positive"):
             mesh_fedavg({"k": np.ones((3, 2), np.float32)}, active=[0.0, 0.0, 0.0])
 
+    def test_all_dropped_cohort_in_mesh_guard(self, monkeypatch):
+        """In a multi-host job the cohort mask is a cross-process sharded
+        array no single process can inspect, so the host-side ValueError
+        can't fire; the IN-MESH guard must then return the incoming global
+        model unchanged — never an all-zero psum average."""
+        import fedcrack_tpu.parallel.fedavg_mesh as fm
+
+        monkeypatch.setattr(fm, "_host_view", lambda x: None)
+        mesh = make_mesh(8, 1)
+        images, masks = _client_data(8)
+        variables = create_train_state(jax.random.key(2), TINY).variables
+        round_fn = build_federated_round(mesh, TINY)
+        new_vars, metrics = round_fn(
+            variables, images, masks,
+            np.zeros(8, np.float32), np.full(8, 8.0, np.float32),
+        )
+        for got, want in zip(
+            jax.tree_util.tree_leaves(new_vars), jax.tree_util.tree_leaves(variables)
+        ):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
     def test_fedprox_mu_changes_result(self):
         mesh = make_mesh(8, 1)
         images, masks = _client_data(8)
