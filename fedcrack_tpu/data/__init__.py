@@ -1,9 +1,11 @@
 from fedcrack_tpu.data.pipeline import (  # noqa: F401
     ArrayDataset,
     CrackDataset,
+    as_model_batch,
     dataset_from_source,
     list_pairs,
     load_example,
+    normalize_images,
     reference_split,
 )
 from fedcrack_tpu.data.sharding import partition_iid, partition_skew  # noqa: F401

@@ -90,8 +90,49 @@ def reference_split(
     return shuffled[:train_samples], shuffled[train_samples:]
 
 
+# One shared normalization constant for BOTH the host decode path and the
+# on-device path: written as an explicit reciprocal multiply because XLA
+# rewrites a divide-by-constant into exactly this multiply — with the host
+# doing a true division the two paths would differ by 1 ulp and "uint8
+# transport is bit-identical" would be a lie.
+_INV255 = np.float32(1.0 / 255.0)
+
+
+def normalize_images(images):
+    """On-device image normalization: uint8 transport bytes -> the model's
+    float32-in-[0,1] contract; float32 passes through. jnp, jit-traceable —
+    the dtype branch resolves at trace time and the multiply fuses into the
+    first conv's input pipeline."""
+    import jax.numpy as jnp
+
+    if images.dtype == jnp.uint8:
+        return images.astype(jnp.float32) * _INV255
+    return images
+
+
+def as_model_batch(images, masks):
+    """Normalize a transport batch (possibly uint8, see ``transport_dtype``)
+    to the model contract: float32 [0,1] images, float32 {0,1} masks.
+
+    Why uint8 transport exists: the decode path resizes in uint8 BEFORE the
+    /255 normalization (exactly like the reference, client_fit_model.py:30-43),
+    so shipping the uint8 bytes and dividing on device is bit-identical to
+    shipping float32 — at 1/4 the host->device bytes (SURVEY.md §7 "input
+    pipeline at TPU speed").
+    """
+    import jax.numpy as jnp
+
+    images = normalize_images(images)
+    if masks.dtype == jnp.uint8:
+        masks = masks.astype(jnp.float32)
+    return images, masks
+
+
 def load_example(
-    image_path: str, mask_path: str, img_size: int
+    image_path: str,
+    mask_path: str,
+    img_size: int,
+    transport_dtype: str = "float32",
 ) -> tuple[np.ndarray, np.ndarray]:
     """Decode one pair to the reference's tensor contract.
 
@@ -99,8 +140,15 @@ def load_example(
     PIL decode + the first-party native resize (fedcrack_tpu.native) — the
     framework does not hard-require cv2 the way the reference does
     (client_fit_model.py:12).
+
+    ``transport_dtype="uint8"`` keeps the resized uint8 bytes (images RGB u8,
+    masks {0,1} u8) for device-side normalization via :func:`as_model_batch`
+    — bit-identical to the float32 path because the resize happens in uint8
+    either way. Falls back to float32 on the PIL path (whose native resize
+    is float-domain).
     """
     cv2 = _cv2()
+    want_u8 = transport_dtype == "uint8"
 
     if cv2 is not None:
         img = cv2.imread(image_path, cv2.IMREAD_COLOR)
@@ -108,14 +156,14 @@ def load_example(
             raise FileNotFoundError(image_path)
         img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
         img = cv2.resize(img, (img_size, img_size))
-        image = img.astype(np.float32) / 255.0
 
         m = cv2.imread(mask_path, cv2.IMREAD_GRAYSCALE)
         if m is None:
             raise FileNotFoundError(mask_path)
         m = cv2.resize(m, (img_size, img_size))
-        mask = (m > 0).astype(np.float32)[..., None]
-        return image, mask
+        if want_u8:
+            return img, (m > 0).astype(np.uint8)[..., None]
+        return img.astype(np.float32) * _INV255, (m > 0).astype(np.float32)[..., None]
 
     from PIL import Image
 
@@ -169,9 +217,12 @@ class CrackDataset:
         num_workers: int = 4,
         prefetch: int = 2,
         drop_last: bool = True,
+        transport_dtype: str = "float32",
     ):
         if not pairs:
             raise ValueError("empty dataset")
+        if transport_dtype not in ("float32", "uint8"):
+            raise ValueError(f"transport_dtype must be float32 or uint8, got {transport_dtype!r}")
         _check_yields_batches(len(pairs), batch_size, drop_last)
         self.pairs = list(pairs)
         self.img_size = img_size
@@ -181,6 +232,9 @@ class CrackDataset:
         self.num_workers = num_workers
         self.prefetch = prefetch
         self.drop_last = drop_last
+        # uint8 requires the cv2 decode path (the PIL fallback resizes in
+        # float); degrade to float32 transport rather than failing decode.
+        self.transport_dtype = transport_dtype if _cv2() is not None else "float32"
         self._epoch = 0
 
     def __len__(self) -> int:
@@ -194,10 +248,13 @@ class CrackDataset:
         ]
 
     def _load_batch(self, idx: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-        images = np.empty((len(idx), self.img_size, self.img_size, 3), np.float32)
-        masks = np.empty((len(idx), self.img_size, self.img_size, 1), np.float32)
+        dt = np.uint8 if self.transport_dtype == "uint8" else np.float32
+        images = np.empty((len(idx), self.img_size, self.img_size, 3), dt)
+        masks = np.empty((len(idx), self.img_size, self.img_size, 1), dt)
         for j, i in enumerate(idx):
-            images[j], masks[j] = load_example(*self.pairs[i], self.img_size)
+            images[j], masks[j] = load_example(
+                *self.pairs[i], self.img_size, transport_dtype=self.transport_dtype
+            )
         return images, masks
 
     def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
@@ -336,6 +393,7 @@ def dataset_from_source(
     num_workers: int | None = None,
     prefetch: int | None = None,
     pair_filter=None,
+    transport_dtype: str = "float32",
 ):
     """One dataset from either source the CLIs accept: ``--synthetic N``
     (generated fixtures -> :class:`ArrayDataset`) or paired
@@ -378,5 +436,6 @@ def dataset_from_source(
         batch_size=max(1, min(batch_size, len(pairs))),
         seed=seed,
         drop_last=drop_last,
+        transport_dtype=transport_dtype,
         **kw,
     )

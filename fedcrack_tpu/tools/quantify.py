@@ -111,7 +111,13 @@ def predict_and_quantify(
     os.makedirs(out_dir, exist_ok=True)
     reports: list[dict] = []
     done = 0
+    from fedcrack_tpu.data.pipeline import normalize_images
+
     for images, _ in dataset:
+        # Datasets may yield uint8 transport bytes (data.pipeline); the model
+        # contract is float32 in [0, 1]. normalize_images keeps the values
+        # bit-identical to what training saw.
+        images = np.asarray(normalize_images(np.asarray(images)))
         probs = jax.device_get(
             jax.nn.sigmoid(state.apply_fn(state.variables, images, train=False))
         )

@@ -129,11 +129,13 @@ def _build_datasets(args, model_config: ModelConfig):
             0, args.image_dir, args.mask_dir,
             img_size=model_config.img_size, batch_size=args.batch,
             seed=args.seed, pair_filter=split_side(0),
+            transport_dtype=args.transport_dtype,
         )
         val = dataset_from_source(
             0, args.image_dir, args.mask_dir,
             img_size=model_config.img_size, batch_size=args.batch,
             seed=args.seed, pair_filter=split_side(1),
+            transport_dtype=args.transport_dtype,
         )
     except ValueError as e:
         raise SystemExit(str(e))
@@ -161,6 +163,13 @@ def main(argv=None) -> None:
         "1 = the reference's plain BCE)",
     )
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--transport-dtype",
+        choices=("uint8", "float32"),
+        default="uint8",
+        help="host->device staging dtype for file datasets; uint8 ships 1/4 "
+        "the bytes and is bit-identical (normalization happens on device)",
+    )
     p.add_argument("--train-samples", type=int, default=6213)
     p.add_argument("--split-seed", type=int, default=1337)
     p.add_argument("--out-dir", default="centralized_out")

@@ -24,6 +24,7 @@ import optax
 from flax import core, struct
 
 from fedcrack_tpu.configs import FedConfig, ModelConfig
+from fedcrack_tpu.data.pipeline import as_model_batch, normalize_images
 from fedcrack_tpu.fed.algorithms import fedprox_penalty
 from fedcrack_tpu.models import ResUNet
 from fedcrack_tpu.ops.losses import iou_from_counts
@@ -95,9 +96,13 @@ def train_step(
     For plain FedAvg pass ``anchor_params=state.params`` and ``mu=0.0`` —
     same compiled program either way. ``pos_weight`` (traced, default 1 =
     reference parity) up-weights crack pixels against the ~7% foreground
-    imbalance.
+    imbalance. Batches may arrive as uint8 transport bytes (1/4 the
+    host->device traffic, ``data.pipeline.as_model_batch``) — the on-device
+    normalization reproduces the float32 staging values bit for bit (step
+    outputs then differ only by XLA's usual program-to-program
+    reduction-order noise).
     """
-    images, masks = batch
+    images, masks = as_model_batch(*batch)
 
     def loss_fn(params):
         logits, mutated = state.apply_fn(
@@ -138,7 +143,7 @@ def eval_step(
     the training objective: selecting checkpoints by unweighted val loss
     while training a weighted objective would prefer exactly the
     low-recall models the weighting exists to avoid."""
-    images, masks = batch
+    images, masks = as_model_batch(*batch)
     logits = state.apply_fn(state.variables, images, train=False)
     return fused_segmentation_metrics(logits, masks, pos_weight=pos_weight)
 
@@ -178,7 +183,7 @@ def _calibration_forward(model_config: ModelConfig):
     def moments_of(params, batch_stats, images):
         _, mutated = model.apply(
             {"params": params, "batch_stats": batch_stats},
-            images,
+            normalize_images(images),
             train=True,
             mutable=["batch_stats"],
         )

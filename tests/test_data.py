@@ -204,3 +204,66 @@ def test_partition_skew_no_empty_shards():
         assert all(len(s) > 0 for s in shards), seed
         flat = np.concatenate(shards)
         assert sorted(flat.tolist()) == list(range(24)), seed
+
+
+def test_uint8_transport_bit_identical(fixture_dirs):
+    """uint8 staging must be EXACTLY the float32 pipeline: the decode path
+    resizes in uint8 before normalizing either way, so on-device /255 of the
+    shipped bytes reproduces the float batch bit for bit at 1/4 the
+    host->device traffic."""
+    from fedcrack_tpu.data import as_model_batch
+
+    pytest.importorskip("cv2")  # without cv2 the dataset degrades to float32
+    image_dir, mask_dir = fixture_dirs
+    pairs = list_pairs(image_dir, mask_dir)
+    f32 = CrackDataset(pairs, img_size=64, batch_size=4, shuffle=False,
+                       num_workers=0)
+    u8 = CrackDataset(pairs, img_size=64, batch_size=4, shuffle=False,
+                      num_workers=0, transport_dtype="uint8")
+    for (fi, fm), (ui, um) in zip(f32, u8):
+        assert ui.dtype == np.uint8 and um.dtype == np.uint8
+        assert ui.nbytes == fi.nbytes // 4
+        ni, nm = as_model_batch(ui, um)
+        np.testing.assert_array_equal(np.asarray(ni), fi)
+        np.testing.assert_array_equal(np.asarray(nm), fm)
+
+
+def test_train_and_eval_steps_accept_uint8_batches():
+    """A uint8 transport batch must train/evaluate the same as its float32
+    equivalent — normalization happens inside the jitted step. The staged
+    VALUES are bit-identical (previous test); the uint8 step is a different
+    XLA program, so outputs carry the usual program-to-program
+    reduction-order noise (same tolerance class as the repo's mesh-vs-host
+    golden tests), nothing more."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedcrack_tpu.configs import ModelConfig
+    from fedcrack_tpu.train.local import create_train_state, eval_step, train_step
+
+    tiny = ModelConfig(
+        img_size=16, stem_features=4, encoder_features=(8,), decoder_features=(8, 4)
+    )
+    rng = np.random.default_rng(3)
+    img_u8 = rng.integers(0, 256, (4, 16, 16, 3), np.uint8)
+    msk_u8 = (rng.random((4, 16, 16, 1)) > 0.8).astype(np.uint8)
+    img_f32 = img_u8.astype(np.float32) * np.float32(1.0 / 255.0)
+    msk_f32 = msk_u8.astype(np.float32)
+
+    state = create_train_state(jax.random.key(0), tiny)
+    mu = jnp.float32(0.0)
+    s_f, m_f = train_step(state, (img_f32, msk_f32), state.params, mu)
+    s_u, m_u = train_step(state, (img_u8, msk_u8), state.params, mu)
+    assert float(m_f["loss"]) == pytest.approx(float(m_u["loss"]), rel=1e-5)
+    # One Adam step at lr=1e-3: any leaf can move at most ~lr, and for
+    # zero-gradient leaves (BN-shadowed biases) reassociation noise flips
+    # the step sign — so the bound is ~2*lr, not exactness.
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s_f.params), jax.tree_util.tree_leaves(s_u.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2.5e-3)
+
+    e_f = eval_step(state, (img_f32, msk_f32))
+    e_u = eval_step(state, (img_u8, msk_u8))
+    assert float(e_f["loss"]) == pytest.approx(float(e_u["loss"]), rel=1e-5)
+    assert float(e_f["iou_inter"]) == pytest.approx(float(e_u["iou_inter"]), abs=1.0)
