@@ -93,7 +93,8 @@ class FedConfig:
     pos_weight: float = 1.0
     # FedOpt server optimizer on the round pseudo-gradient (Reddi et al.):
     # "avg" = plain FedAvg (the reference's behavior), "momentum"/"fedavgm",
-    # "adam"/"fedadam". Applied to params only; BN stats are plain-averaged.
+    # "adam"/"fedadam", "yogi"/"fedyogi". Applied to params only; BN stats
+    # are plain-averaged.
     server_optimizer: str = "avg"
     server_lr: float = 1.0
     server_momentum: float = 0.9

@@ -114,12 +114,19 @@ def fedprox_penalty(params: Any, anchor: Any, mu: float) -> jax.Array:
 # statistics are plain-averaged (momentum on running moments is meaningless).
 
 
-def _adam_no_bias_correction(lr: float, b1: float, b2: float, eps: float):
-    """Reddi et al.'s FedAdam update, exactly: ``m = b1*m + (1-b1)*g``,
-    ``v = b2*v + (1-b2)*g^2``, step ``-lr * m / (sqrt(v) + eps)`` — with NO
-    bias correction. ``optax.adam`` bias-corrects, which changes the
-    effective step size of early rounds relative to the paper's algorithm,
-    so the server optimizer hand-rolls the two moment updates instead."""
+def _fedopt_adaptive(lr: float, b1: float, b2: float, eps: float, variant: str):
+    """Reddi et al.'s adaptive server updates, exactly as in the paper —
+    ``m = b1*m + (1-b1)*g`` and a per-variant second moment, step
+    ``-lr * m / (sqrt(v) + eps)`` with NO bias correction (``optax.adam``
+    bias-corrects, which changes the effective step size of early rounds
+    relative to the paper's algorithm, so the moments are hand-rolled):
+
+    - ``adam`` (FedAdam):  ``v = b2*v + (1-b2)*g^2``
+    - ``yogi`` (FedYogi):  ``v = v - (1-b2)*sign(v - g^2)*g^2`` — the
+      additive update reacts slower when ``v`` overshoots, which the paper
+      found more stable under heterogeneous client drift. From ``v = 0``
+      the first step coincides with FedAdam.
+    """
     import optax
 
     def init(params):
@@ -128,17 +135,19 @@ def _adam_no_bias_correction(lr: float, b1: float, b2: float, eps: float):
         )
         return (zeros(params), zeros(params))
 
+    def _v_update(vi, g):
+        g2 = jnp.square(g.astype(jnp.float32))
+        if variant == "yogi":
+            return vi - (1.0 - b2) * jnp.sign(vi - g2) * g2
+        return b2 * vi + (1.0 - b2) * g2
+
     def update(grads, state, params=None):
         del params
         m, v = state
         m = jax.tree_util.tree_map(
             lambda mi, g: b1 * mi + (1.0 - b1) * g.astype(jnp.float32), m, grads
         )
-        v = jax.tree_util.tree_map(
-            lambda vi, g: b2 * vi + (1.0 - b2) * jnp.square(g.astype(jnp.float32)),
-            v,
-            grads,
-        )
+        v = jax.tree_util.tree_map(_v_update, v, grads)
         updates = jax.tree_util.tree_map(
             lambda mi, vi: -lr * mi / (jnp.sqrt(vi) + eps), m, v
         )
@@ -157,7 +166,9 @@ def make_server_optimizer(kind: str, lr: float = 1.0, momentum: float = 0.9):
         return optax.sgd(lr, momentum=momentum)
     if kind in ("adam", "fedadam"):
         # Paper hyperparameters AND paper update rule (no bias correction).
-        return _adam_no_bias_correction(lr, b1=0.9, b2=0.99, eps=1e-3)
+        return _fedopt_adaptive(lr, b1=0.9, b2=0.99, eps=1e-3, variant="adam")
+    if kind in ("yogi", "fedyogi"):
+        return _fedopt_adaptive(lr, b1=0.9, b2=0.99, eps=1e-3, variant="yogi")
     raise ValueError(f"unknown server optimizer {kind!r}")
 
 

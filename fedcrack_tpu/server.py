@@ -43,7 +43,7 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         "--server-optimizer",
         dest="server_optimizer",
         help="FedOpt server update: avg (plain FedAvg), momentum/fedavgm, "
-        "adam/fedadam",
+        "adam/fedadam, yogi/fedyogi",
     )
     p.add_argument("--server-lr", type=float, dest="server_lr")
     p.add_argument("--server-momentum", type=float, dest="server_momentum")

@@ -331,6 +331,30 @@ class TestFedOpt:
         np.testing.assert_allclose(blobs[0]["params"]["w"], expected[0], rtol=1e-5)
         np.testing.assert_allclose(blobs[1]["params"]["w"], expected[1], rtol=1e-5)
 
+    def test_fedyogi_closed_form(self):
+        """Reddi et al.'s FedYogi second moment is additive:
+        v_t = v_{t-1} - (1-b2)*sign(v_{t-1} - g^2)*g^2 — pins the update
+        against the recurrence over two rounds (which diverges from FedAdam
+        at round 2, checked explicitly)."""
+        lr, b1, b2, eps = 0.1, 0.9, 0.99, 1e-3
+        cfg = self._cfg(server_optimizer="fedyogi", server_lr=lr)
+        _, blobs = self._session(cfg, [5.0, 3.0])
+        x, m, v = 0.0, 0.0, 0.0
+        expected = []
+        adam_v = 0.0
+        adam_diverges = False
+        for avg in (5.0, 3.0):
+            g = x - avg
+            m = b1 * m + (1 - b1) * g
+            adam_v = b2 * adam_v + (1 - b2) * g * g
+            v = v - (1 - b2) * np.sign(v - g * g) * g * g
+            adam_diverges = adam_diverges or abs(v - adam_v) > 1e-9
+            x = x - lr * m / (np.sqrt(v) + eps)
+            expected.append(x)
+        assert adam_diverges  # the recurrences genuinely differ by round 2
+        np.testing.assert_allclose(blobs[0]["params"]["w"], expected[0], rtol=1e-5)
+        np.testing.assert_allclose(blobs[1]["params"]["w"], expected[1], rtol=1e-5)
+
     def test_unknown_kind_rejected(self):
         from fedcrack_tpu.fed.algorithms import make_server_optimizer
 
