@@ -246,6 +246,51 @@ class TestMeshMatchesHost:
         assert max(diffs) > 1e-7
 
 
+    def test_remat_round_matches_plain(self):
+        """jax.checkpoint recomputes the forward during backward — the
+        round's math is unchanged; only the activation-memory/FLOPs schedule
+        moves. Guards the HBM lever for crops that don't otherwise fit."""
+        mesh = make_mesh(4, 2)
+        images, masks = _client_data(4)
+        variables = create_train_state(jax.random.key(9), TINY).variables
+        ones, ns = np.ones(4, np.float32), np.full(4, 8.0, np.float32)
+        plain = build_federated_round(mesh, TINY)
+        rematd = build_federated_round(mesh, TINY, remat=True)
+        v_plain, m_plain = plain(variables, images, masks, ones, ns)
+        v_remat, m_remat = rematd(variables, images, masks, ones, ns)
+        np.testing.assert_allclose(
+            np.asarray(m_plain["loss"]), np.asarray(m_remat["loss"]), rtol=1e-6
+        )
+        _assert_trees_match(v_remat["params"], v_plain["params"])
+
+    def test_remat_spatial_round_matches_plain(self):
+        """The riskier remat composition: checkpointing the halo-exchange
+        spatial forward rematerializes ppermute + sync-BN collectives in
+        the backward — this is the path remat exists for (crops too large
+        per chip), so its parity is pinned separately."""
+        from fedcrack_tpu.parallel import build_spatial_federated_round
+
+        # Per-shard height must be a multiple of 16: 32px / 2 spatial shards.
+        tiny32 = ModelConfig(
+            img_size=32, stem_features=4, encoder_features=(8,),
+            decoder_features=(8, 4),
+        )
+        per_client = [
+            synth_crack_batch(STEPS * BATCH, img_size=32, seed=i) for i in range(4)
+        ]
+        images, masks = stack_client_data(per_client, STEPS, BATCH)
+        mesh = make_mesh(4, 2, axis_names=("clients", "space"))
+        variables = create_train_state(jax.random.key(9), tiny32).variables
+        ones, ns = np.ones(4, np.float32), np.full(4, 8.0, np.float32)
+        plain = build_spatial_federated_round(mesh, tiny32)
+        rematd = build_spatial_federated_round(mesh, tiny32, remat=True)
+        v_plain, m_plain = plain(variables, images, masks, ones, ns)
+        v_remat, m_remat = rematd(variables, images, masks, ones, ns)
+        np.testing.assert_allclose(
+            np.asarray(m_plain["loss"]), np.asarray(m_remat["loss"]), rtol=1e-6
+        )
+        _assert_trees_match(v_remat["params"], v_plain["params"])
+
 class TestMeshFedavgGolden:
     def test_matches_numpy_mean(self):
         rng = np.random.default_rng(0)
