@@ -131,6 +131,11 @@ class FedConfig:
     # Msgpack pytree seeding the initial global model (e.g. from the Keras h5
     # importer, tools/h5_import.py); empty initializes from `seed`.
     init_weights: str = ""
+    # When server-side eval runs (server --eval-*), the best global model by
+    # eval loss is kept here as a msgpack pytree with a .json metrics sidecar
+    # — the federated analog of the reference's best-val ModelCheckpoint
+    # (test/Segmentation.py:177-179). Empty disables.
+    best_path: str = ""
     max_message_mb: int = 512     # reference: fl_server.py:215 (both directions here)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)

@@ -83,6 +83,14 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
     p.add_argument("--eval-image-dir", help="server-side eval images")
     p.add_argument("--eval-mask-dir", help="server-side eval masks")
     p.add_argument(
+        "--best-path",
+        dest="best_path",
+        help="keep the best global model by server-side eval loss here "
+        "(msgpack + .json metrics sidecar) — the federated analog of the "
+        "reference's best-val ModelCheckpoint (test/Segmentation.py:177-179); "
+        "requires --eval-*",
+    )
+    p.add_argument(
         "--logs-dir",
         dest="logs_dir",
         help="sink directory for client-uploaded log files (reference 'L' "
@@ -121,6 +129,7 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         ("tb_dir", "tb_dir"),
         ("logs_dir", "logs_dir"),
         ("init_weights", "init_weights"),
+        ("best_path", "best_path"),
     ]:
         val = getattr(args, flag)
         if val is not None:
@@ -169,6 +178,12 @@ def main(argv: list[str] | None = None) -> int:
             st = recalibrate_batch_stats(st, eval_dataset, cfg.model)
             return evaluate(st, eval_dataset, pos_weight=cfg.pos_weight)
 
+    if cfg.best_path and eval_fn is None:
+        logging.warning(
+            "--best-path %s is set but server-side eval is off (no --eval-*): "
+            "no best model will ever be written",
+            cfg.best_path,
+        )
     if cfg.init_weights:
         from fedcrack_tpu.fed.serialization import tree_from_bytes
 

@@ -62,6 +62,28 @@ def _safe_component(name: str) -> str:
     return cleaned
 
 
+def _write_best(path: str, blob: bytes, entry: dict) -> None:
+    """Persist the best global model (msgpack bytes) plus a JSON sidecar with
+    the eval metrics that earned it. Each file lands via tmp+rename, so
+    neither is ever torn; the pair is two renames, so the sidecar carries a
+    sha256 of the blob — a crash between the renames is detectable by
+    hashing the model file against its sidecar."""
+    import hashlib as _hashlib
+    import json
+
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+    side = f"{path}.json"
+    tmp = f"{side}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({**entry, "sha256": _hashlib.sha256(blob).hexdigest()}, f, sort_keys=True)
+    os.replace(tmp, side)
+
+
 def channel_options(max_message_mb: int) -> list[tuple[str, int]]:
     cap = max_message_mb * 1024 * 1024
     return [
@@ -107,6 +129,11 @@ class FedServer:
         # eval_fn(global_blob) -> {"loss": ..., "iou": ..., ...}.
         self._eval_fn = eval_fn
         self.eval_history: list[dict] = []
+        # Best-global-model retention by eval loss (config.best_path) — the
+        # federated analog of the reference's best-val ModelCheckpoint
+        # (test/Segmentation.py:177-179).
+        self.best_eval: dict | None = None
+        self._best_lock = asyncio.Lock()
         self._clock = clock
         self._tick_period_s = tick_period_s
         self._lock = asyncio.Lock()
@@ -180,6 +207,26 @@ class FedServer:
         log.info("global model eval: %s", entry)
         if self._metrics is not None:
             await asyncio.to_thread(self._metrics.log, "server_eval", **entry)
+        if self.config.best_path and "loss" in result:
+            # Compare-and-write under one lock: per-round eval tasks can
+            # overlap, and the best file must never mix rounds.
+            async with self._best_lock:
+                if self.best_eval is None or result["loss"] < self.best_eval["loss"]:
+                    try:
+                        await asyncio.to_thread(
+                            _write_best, self.config.best_path, state.global_blob, entry
+                        )
+                    except Exception:
+                        # best_eval deliberately NOT updated: a failed write
+                        # must leave later (worse-than-this, better-than-disk)
+                        # rounds eligible to replace what's actually on disk.
+                        log.exception("best-model save failed for round %s", rnd)
+                    else:
+                        self.best_eval = entry
+                        log.info(
+                            "new best global model (loss %.6f, round %s) -> %s",
+                            result["loss"], rnd, self.config.best_path,
+                        )
 
     async def _tick_forever(self) -> None:
         """Drives pure time effects: enrollment-window close and round

@@ -335,6 +335,42 @@ def test_server_side_eval_runs_per_round(session_cfg, tmp_path):
     assert all(e["loss"] == 0.5 for e in server.eval_history)
 
 
+def test_best_global_model_retained_by_eval_loss(session_cfg, tmp_path):
+    """config.best_path keeps the best-by-eval-loss aggregated model — the
+    federated analog of the reference's best-val ModelCheckpoint
+    (test/Segmentation.py:177-179). Later worse rounds must NOT overwrite
+    it; the sidecar records which round earned the file."""
+    import json
+
+    losses = iter([0.9, 0.2, 0.7])  # best is round 2
+
+    def eval_fn(blob):
+        return {"loss": next(losses)}
+
+    best = tmp_path / "best" / "global.msgpack"
+    cfg = dataclasses.replace(
+        session_cfg, cohort_size=1, max_rounds=3, best_path=str(best)
+    )
+    server = FedServer(cfg, _vars(0.0), tick_period_s=0.05, eval_fn=eval_fn)
+    with ServerThread(server) as st:
+        result = FedClient(
+            cfg, _fake_train(1.0, 10), cname="a", port=st.port
+        ).run_session()
+
+    assert result.rounds_completed == 3
+    assert server.best_eval is not None and server.best_eval["loss"] == 0.2
+    # The file holds round 2's aggregated weights (w=0 + 1 + 1), not round 3's.
+    tree = tree_from_bytes(best.read_bytes())
+    np.testing.assert_allclose(tree["params"]["w"], 2.0)
+    side = json.loads((tmp_path / "best" / "global.msgpack.json").read_text())
+    assert side["round"] == 2 and side["loss"] == 0.2
+    # The sidecar's content hash binds it to the model file (detects a crash
+    # between the two renames).
+    import hashlib
+
+    assert side["sha256"] == hashlib.sha256(best.read_bytes()).hexdigest()
+
+
 def test_handshake_hyperparameters_reach_trainer(session_cfg):
     """The server's local_epochs / learning_rate / fedprox_mu ride the
     enroll handshake config map and are handed to the client's train_fn —
