@@ -38,6 +38,7 @@ def train_centralized(
     log_fn=print,
     recalibrate_bn: bool = True,
     pos_weight: float = 1.0,
+    metrics=None,
 ) -> tuple[TrainState, list[dict]]:
     """Returns the final state and per-epoch history; writes
     ``best.msgpack`` (lowest val loss) and ``final.msgpack`` to ``out_dir``.
@@ -73,6 +74,11 @@ def train_centralized(
             **{f"val_{k}": v for k, v in val_metrics.items()},
         }
         history.append(entry)
+        if metrics is not None:
+            # Structured per-epoch record (JSONL + TB scalars) — the
+            # reference's TensorBoard-per-fit workflow
+            # (client_fit_model.py:153-154) for the centralized entry point.
+            metrics.log("epoch", **entry)
         log_fn(
             f"epoch {epoch}: train_loss={train_metrics['loss']:.4f} "
             f"val_loss={val_metrics['loss']:.4f} val_iou={val_metrics['iou']:.4f}"
@@ -173,8 +179,24 @@ def main(argv=None) -> None:
     p.add_argument("--train-samples", type=int, default=6213)
     p.add_argument("--split-seed", type=int, default=1337)
     p.add_argument("--out-dir", default="centralized_out")
+    p.add_argument(
+        "--metrics", dest="metrics_path", help="JSONL file for per-epoch metrics"
+    )
+    p.add_argument(
+        "--tb-dir",
+        dest="tb_dir",
+        help="TensorBoard event-file directory for per-epoch scalars (the "
+        "reference's TB-per-fit workflow, client_fit_model.py:153-154)",
+    )
     args = p.parse_args(argv)
 
+    metrics = None
+    if args.metrics_path or args.tb_dir:
+        from fedcrack_tpu.obs import MetricsLogger
+
+        metrics = MetricsLogger(
+            args.metrics_path or os.devnull, tb_dir=args.tb_dir or None
+        )
     model_config = ModelConfig(img_size=args.img_size)
     train, val = _build_datasets(args, model_config)
     _, history = train_centralized(
@@ -186,6 +208,7 @@ def main(argv=None) -> None:
         out_dir=args.out_dir,
         seed=args.seed,
         pos_weight=args.pos_weight,
+        metrics=metrics,
     )
     best = min(h["val_loss"] for h in history)
     print(f"done: {len(history)} epochs, best val_loss={best:.4f} -> {args.out_dir}")

@@ -62,6 +62,33 @@ def _safe_component(name: str) -> str:
     return cleaned
 
 
+def _load_best(path: str) -> dict | None:
+    """Seed best-model tracking from an existing best file's sidecar, so a
+    resumed server never overwrites a better on-disk model with its first
+    post-restart eval. A sidecar whose sha256 does not match the model file
+    (crash between the pair's two renames) is ignored — the torn pair is
+    then eligible for replacement by the next eval."""
+    import hashlib as _hashlib
+    import json
+    import math
+
+    side = f"{path}.json"
+    try:
+        with open(side) as f:
+            entry = json.load(f)
+        with open(path, "rb") as f:
+            blob = f.read()
+    except (OSError, ValueError):
+        return None
+    if entry.get("sha256") != _hashlib.sha256(blob).hexdigest():
+        log.warning("best-model sidecar %s does not match %s; ignoring", side, path)
+        return None
+    loss = entry.get("loss")
+    if not isinstance(loss, (int, float)) or not math.isfinite(loss):
+        return None
+    return entry
+
+
 def _write_best(path: str, blob: bytes, entry: dict) -> None:
     """Persist the best global model (msgpack bytes) plus a JSON sidecar with
     the eval metrics that earned it. Each file lands via tmp+rename, so
@@ -131,8 +158,17 @@ class FedServer:
         self.eval_history: list[dict] = []
         # Best-global-model retention by eval loss (config.best_path) — the
         # federated analog of the reference's best-val ModelCheckpoint
-        # (test/Segmentation.py:177-179).
-        self.best_eval: dict | None = None
+        # (test/Segmentation.py:177-179). Seeded from the existing file's
+        # sidecar so restarts can't regress what's on disk.
+        self.best_eval: dict | None = (
+            _load_best(config.best_path) if config.best_path else None
+        )
+        if self.best_eval is not None:
+            log.info(
+                "resuming best-model tracking: loss %.6f from round %s",
+                self.best_eval["loss"],
+                self.best_eval.get("round"),
+            )
         self._best_lock = asyncio.Lock()
         self._clock = clock
         self._tick_period_s = tick_period_s
@@ -208,10 +244,17 @@ class FedServer:
         if self._metrics is not None:
             await asyncio.to_thread(self._metrics.log, "server_eval", **entry)
         if self.config.best_path and "loss" in result:
+            import math
+
             # Compare-and-write under one lock: per-round eval tasks can
-            # overlap, and the best file must never mix rounds.
+            # overlap, and the best file must never mix rounds. Non-finite
+            # losses never qualify — a NaN admitted as "best" would compare
+            # False against every later loss and pin the file forever.
+            loss = result["loss"]
             async with self._best_lock:
-                if self.best_eval is None or result["loss"] < self.best_eval["loss"]:
+                if math.isfinite(loss) and (
+                    self.best_eval is None or loss < self.best_eval["loss"]
+                ):
                     try:
                         await asyncio.to_thread(
                             _write_best, self.config.best_path, state.global_blob, entry

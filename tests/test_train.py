@@ -104,6 +104,34 @@ def test_centralized_trainer_checkpoints_best(tmp_path, fixture_data):
     assert all(np.array_equal(g, w) for g, w in zip(got, want))
 
 
+def test_centralized_trainer_emits_structured_metrics(tmp_path):
+    """The centralized entry point tees per-epoch records to JSONL + real
+    TensorBoard event files, like the federated entry points (the
+    reference's TB-per-fit workflow, client_fit_model.py:153-154)."""
+    import glob
+
+    from fedcrack_tpu.obs import MetricsLogger, read_metrics, read_scalars
+    from fedcrack_tpu.train.centralized import train_centralized
+
+    images, masks = synth_crack_batch(12, 32, seed=4)
+    train_ds = ArrayDataset(images[:8], masks[:8], batch_size=4, seed=0)
+    val_ds = ArrayDataset(images[8:], masks[8:], batch_size=4, shuffle=False)
+    jsonl = tmp_path / "m.jsonl"
+    tb = tmp_path / "tb"
+    logger = MetricsLogger(jsonl, tb_dir=tb)
+    train_centralized(
+        train_ds, val_ds, CFG32, epochs=2, log_fn=lambda s: None, metrics=logger
+    )
+    logger.close()
+    records = [r for r in read_metrics(jsonl) if r["kind"] == "epoch"]
+    assert [r["epoch"] for r in records] == [0, 1]
+    assert all("val_iou" in r and "train_loss" in r for r in records)
+    event_files = glob.glob(str(tb / "events.out.tfevents.*"))
+    assert event_files, "no TB event file written"
+    tags = {t for t, _, _ in read_scalars(event_files[0])}
+    assert any("val_loss" in t for t in tags), tags
+
+
 @pytest.mark.slow
 def test_centralized_reaches_iou_floor():
     """The framework must SEGMENT CRACKS, not just minimize a scalar: the

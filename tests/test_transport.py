@@ -370,6 +370,40 @@ def test_best_global_model_retained_by_eval_loss(session_cfg, tmp_path):
 
     assert side["sha256"] == hashlib.sha256(best.read_bytes()).hexdigest()
 
+    # Restart semantics: a new server seeded from the same best_path must
+    # NOT let a worse first eval overwrite the on-disk best...
+    server2 = FedServer(
+        cfg, _vars(0.0), tick_period_s=0.05, eval_fn=lambda blob: {"loss": 0.8}
+    )
+    assert server2.best_eval is not None and server2.best_eval["loss"] == 0.2
+    with ServerThread(server2) as st:
+        FedClient(cfg, _fake_train(1.0, 10), cname="a", port=st.port).run_session()
+    side2 = json.loads((tmp_path / "best" / "global.msgpack.json").read_text())
+    assert side2["loss"] == 0.2  # the 0.8 post-restart evals never overwrote it
+
+
+def test_best_model_rejects_non_finite_loss(session_cfg, tmp_path):
+    """A NaN first eval must never be admitted as 'best' — NaN compares
+    False against everything, which would pin a diverged model forever."""
+    import json
+    import math
+
+    losses = iter([float("nan"), 0.4])
+
+    def eval_fn(blob):
+        return {"loss": next(losses)}
+
+    best = tmp_path / "global.msgpack"
+    cfg = dataclasses.replace(
+        session_cfg, cohort_size=1, max_rounds=2, best_path=str(best)
+    )
+    server = FedServer(cfg, _vars(0.0), tick_period_s=0.05, eval_fn=eval_fn)
+    with ServerThread(server) as st:
+        FedClient(cfg, _fake_train(1.0, 10), cname="a", port=st.port).run_session()
+    assert server.best_eval is not None and server.best_eval["loss"] == 0.4
+    side = json.loads((tmp_path / "global.msgpack.json").read_text())
+    assert math.isfinite(side["loss"]) and side["round"] == 2
+
 
 def test_handshake_hyperparameters_reach_trainer(session_cfg):
     """The server's local_epochs / learning_rate / fedprox_mu ride the
