@@ -5,12 +5,25 @@ Built from scratch in JAX/Flax/XLA with the capabilities of the reference
 
 - ``models``    — residual U-Net (Flax) mirroring the reference architecture
                   (reference: client_fit_model.py:92-150).
-- ``ops``       — losses/metrics (sigmoid-BCE, pixel accuracy, IoU).
-- ``data``      — crack-image input pipeline with host-side prefetch; synthetic
-                  fixtures; IID/non-IID client sharding
-                  (reference: client_fit_model.py:19-90).
+- ``ops``       — losses/metrics (sigmoid-BCE, pixel accuracy, IoU) incl. the
+                  fused Pallas BCE+stats kernel.
+- ``data``      — crack-image input pipeline with host-side prefetch and uint8
+                  device staging; synthetic fixtures; IID/non-IID client
+                  sharding (reference: client_fit_model.py:19-90).
+- ``train``     — jitted local trainer, centralized baseline, BN recalibration.
+- ``fed``       — pure federation logic: round state machine, FedAvg/FedProx/
+                  FedOpt (FedAvgM, FedAdam, FedYogi), msgpack serialization.
+- ``transport`` — asyncio gRPC control plane (enroll/rounds/version/log upload).
+- ``parallel``  — the TPU data plane: one-program mesh rounds (shard_map +
+                  masked psum FedAvg), intra-client batch DP, spatial context
+                  parallelism with halo exchange, multi-host bring-up.
+- ``obs``       — structured JSONL metrics, TensorBoard export, FLOPs/MFU.
+- ``ckpt``      — orbax checkpoint/resume for the coordinator.
+- ``tools``     — Keras h5 weight import, crack quantification.
+- ``native``    — first-party C++ host runtime (resize/binarize, CRC32C).
 
-See SURVEY.md §7 for the full build plan this package follows.
+See SURVEY.md §7 for the full build plan this package follows and PARITY.md
+for the reference-component map.
 """
 
 __version__ = "0.1.0"
