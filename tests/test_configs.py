@@ -1,0 +1,76 @@
+"""Config system: serialization round-trips, preset files, compatibility.
+
+The reference has no config system (SURVEY.md §5.6); here every knob rides
+one dataclass that must survive JSON round-trips (it travels in-band in the
+protocol handshake) and load every checked-in preset — a rotten preset or a
+broken from_dict kills the CLI entry points at startup.
+"""
+
+import glob
+import json
+import os
+
+import pytest
+
+from fedcrack_tpu.configs import DataConfig, FedConfig, ModelConfig
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_every_checked_in_preset_loads():
+    presets = sorted(glob.glob(os.path.join(ROOT, "configs", "*.json")))
+    assert len(presets) >= 5, presets  # the five BASELINE configs
+    for path in presets:
+        with open(path) as f:
+            cfg = FedConfig.from_json(f.read())
+        # The cross-field invariant every loaded config must satisfy.
+        assert cfg.data.img_size == cfg.model.img_size, path
+        assert cfg.max_rounds >= 1, path
+
+
+def test_json_round_trip_preserves_everything():
+    cfg = FedConfig(
+        max_rounds=7,
+        cohort_size=3,
+        fedprox_mu=0.01,
+        pos_weight=5.0,
+        server_optimizer="fedyogi",
+        wire_dtype="bfloat16",
+        best_path="/tmp/b.msgpack",
+        model=ModelConfig(img_size=256, compute_dtype="bfloat16"),
+        data=DataConfig(img_size=256, batch_size=32, partition="skew"),
+    )
+    assert FedConfig.from_json(cfg.to_json()) == cfg
+
+
+def test_old_configs_without_new_fields_still_load():
+    """Forward compatibility: presets written before a field existed (e.g.
+    best_path, pos_weight) must load with defaults, and unknown keys from a
+    NEWER version must be ignored rather than crash an older server."""
+    old = json.loads(FedConfig().to_json())
+    for newer_field in ("best_path", "pos_weight", "server_optimizer", "tb_dir"):
+        old.pop(newer_field, None)
+    old["some_future_knob"] = 42
+    old["model"]["another_future_knob"] = True
+    cfg = FedConfig.from_dict(old)
+    assert cfg.best_path == "" and cfg.pos_weight == 1.0
+    assert cfg.server_optimizer == "avg"
+
+
+def test_invalid_configs_rejected_at_construction():
+    with pytest.raises(ValueError, match="multiple of 16"):
+        ModelConfig(img_size=100)
+    with pytest.raises(ValueError, match="wire_dtype"):
+        FedConfig(wire_dtype="float16")
+    with pytest.raises(ValueError, match="must match"):
+        FedConfig(model=ModelConfig(img_size=256), data=DataConfig(img_size=128))
+
+
+def test_encoder_features_survive_json_as_tuples():
+    cfg = FedConfig(
+        model=ModelConfig(encoder_features=(32, 64), decoder_features=(64, 32, 16, 8))
+    )
+    back = FedConfig.from_json(cfg.to_json())
+    assert back.model.encoder_features == (32, 64)
+    assert isinstance(back.model.encoder_features, tuple)
+    assert back == cfg
