@@ -1,1 +1,3 @@
+from fedcrack_tpu.tools.h5_export import export_resunet_h5  # noqa: F401
+from fedcrack_tpu.tools.h5_import import import_resunet_h5  # noqa: F401
 from fedcrack_tpu.tools.quantify import CrackStats, quantify_mask  # noqa: F401

@@ -1,0 +1,188 @@
+"""Flax ResUNet -> Keras h5 weight exporter (inverse of tools/h5_import.py).
+
+A user of the reference keeps their tooling around ``crack_segmentation.h5``
+checkpoints (reference: test/Segmentation.py:177-179, loaded by
+test/Segmentation2.py:94); this exporter writes a federation-trained global
+model (e.g. the server's ``--best-path`` msgpack) as a legacy Keras h5 that
+``keras.Model.load_weights`` consumes directly — so switching to this
+framework is a two-way door.
+
+Layout written: the legacy full-model-h5 weight schema (``model_weights``
+group, ``layer_names``/``weight_names`` attrs) that this image's Keras
+emits for ``model.save`` — verified round-trip against real Keras in
+tests/test_h5_export.py. Only weighted layers are listed, in the reference
+model's creation order; Keras' legacy loader matches by order, not name.
+
+Kernel-layout conversions are the exact inverses of h5_import.py:
+
+- ``Conv2D``: unchanged.
+- ``SeparableConv2D``: Flax depthwise ``(kh, kw, 1, in)`` ->
+  Keras ``(kh, kw, in, 1)`` (transpose last two axes).
+- ``Conv2DTranspose``: Flax ``(kh, kw, in, out)`` -> flip both spatial axes
+  and swap channel axes -> Keras' gradient-of-conv ``(kh, kw, out, in)``.
+- ``BatchNorm``: ``scale``/``bias`` -> gamma/beta; ``batch_stats`` -> moving
+  mean/variance.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from fedcrack_tpu.configs import ModelConfig
+
+try:  # pragma: no cover - h5py ships with the image
+    import h5py
+
+    HAVE_H5PY = True
+except ImportError:  # pragma: no cover
+    HAVE_H5PY = False
+
+
+def _f32(x) -> np.ndarray:
+    return np.asarray(x, np.float32)
+
+
+def _layer_entries(variables: dict, config: ModelConfig) -> list[tuple[str, dict]]:
+    """(layer_name, {weight_base: array}) in the Keras model's creation
+    order: stem conv+bn, per encoder block [sep1, bn1, sep2, bn2, res],
+    per decoder block [convT1, bn1, convT2, bn2, res], head. Layer names
+    carry 'transpose' for ConvT so h5_import's classifier re-reads our own
+    files correctly."""
+    p = variables["params"]
+    s = variables["batch_stats"]
+
+    def conv(name):
+        return name, {"kernel": _f32(p[name]["kernel"]), "bias": _f32(p[name]["bias"])}
+
+    def bn(name):
+        return name, {
+            "gamma": _f32(p[name]["scale"]),
+            "beta": _f32(p[name]["bias"]),
+            "moving_mean": _f32(s[name]["mean"]),
+            "moving_variance": _f32(s[name]["var"]),
+        }
+
+    def sep(name):
+        dw = _f32(p[name]["depthwise"]["kernel"])  # (kh, kw, 1, in)
+        return name, {
+            "depthwise_kernel": np.transpose(dw, (0, 1, 3, 2)),  # -> (kh, kw, in, 1)
+            "pointwise_kernel": _f32(p[name]["pointwise"]["kernel"]),
+            "bias": _f32(p[name]["pointwise"]["bias"]),
+        }
+
+    def convT(flax_name, file_name):
+        k = _f32(p[flax_name]["kernel"])  # (kh, kw, in, out), un-flipped
+        return file_name, {
+            "kernel": np.transpose(k[::-1, ::-1], (0, 1, 3, 2)),  # -> (kh, kw, out, in)
+            "bias": _f32(p[flax_name]["bias"]),
+        }
+
+    entries = [conv("stem_conv"), bn("stem_bn")]
+    for i in range(len(config.encoder_features)):
+        entries += [
+            sep(f"enc{i}_sep1"), bn(f"enc{i}_bn1"),
+            sep(f"enc{i}_sep2"), bn(f"enc{i}_bn2"),
+            conv(f"enc{i}_res"),
+        ]
+    for i in range(len(config.decoder_features)):
+        entries += [
+            convT(f"dec{i}_convT1", f"dec{i}_conv_transpose1"), bn(f"dec{i}_bn1"),
+            convT(f"dec{i}_convT2", f"dec{i}_conv_transpose2"), bn(f"dec{i}_bn2"),
+            conv(f"dec{i}_res"),
+        ]
+    entries.append(conv("head"))
+    return entries
+
+
+def _check_structure(variables: dict, config: ModelConfig) -> None:
+    """Every module in ``variables`` must be consumed by the export — a
+    config declaring fewer blocks than the weights hold would otherwise
+    produce a well-formed h5 with blocks silently missing (the importer's
+    invariant is 'a mismatch raises instead of silently mis-seeding'; the
+    exporter holds the same line)."""
+    n_enc = len(config.encoder_features)
+    n_dec = len(config.decoder_features)
+    expected_params = {"stem_conv", "stem_bn", "head"}
+    expected_stats = {"stem_bn"}
+    for i in range(n_enc):
+        expected_params |= {f"enc{i}_sep1", f"enc{i}_bn1", f"enc{i}_sep2",
+                            f"enc{i}_bn2", f"enc{i}_res"}
+        expected_stats |= {f"enc{i}_bn1", f"enc{i}_bn2"}
+    for i in range(n_dec):
+        expected_params |= {f"dec{i}_convT1", f"dec{i}_bn1", f"dec{i}_convT2",
+                            f"dec{i}_bn2", f"dec{i}_res"}
+        expected_stats |= {f"dec{i}_bn1", f"dec{i}_bn2"}
+    for tree, expected, label in (
+        (variables["params"], expected_params, "params"),
+        (variables["batch_stats"], expected_stats, "batch_stats"),
+    ):
+        got = set(tree.keys())
+        if got != expected:
+            raise ValueError(
+                f"{label} structure does not match the export config: "
+                f"unconsumed {sorted(got - expected)}, "
+                f"missing {sorted(expected - got)}"
+            )
+
+
+def export_resunet_h5(
+    variables: dict, path: str, config: ModelConfig | None = None
+) -> None:
+    """Write ``{'params','batch_stats'}`` as a Keras-loadable legacy h5."""
+    if not HAVE_H5PY:  # pragma: no cover
+        raise ImportError("h5py is required for Keras h5 export")
+    config = config or ModelConfig()
+    _check_structure(variables, config)
+    entries = _layer_entries(variables, config)
+    str_dt = h5py.special_dtype(vlen=str)
+    with h5py.File(path, "w") as f:
+        root = f.create_group("model_weights")
+        for g in (f, root):
+            g.attrs["backend"] = "tensorflow"
+            g.attrs["keras_version"] = "3"
+        root.attrs.create(
+            "layer_names", [name for name, _ in entries], dtype=str_dt
+        )
+        for name, weights in entries:
+            group = root.create_group(name)
+            weight_names = [f"{name}/{base}" for base in weights]
+            group.attrs.create("weight_names", weight_names, dtype=str_dt)
+            for base, arr in weights.items():
+                group.create_dataset(f"{name}/{base}", data=arr)
+
+
+def main(argv: list[str] | None = None) -> int:
+    """CLI: ``python -m fedcrack_tpu.tools.h5_export model.msgpack out.h5``."""
+    import argparse
+
+    import jax
+
+    from fedcrack_tpu.fed.serialization import tree_from_bytes
+    from fedcrack_tpu.models.resunet import init_variables
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("msgpack_path", help="msgpack pytree (fed/serialization format, "
+                   "e.g. the server's --best-path or centralized best.msgpack)")
+    p.add_argument("out_path", help="Keras h5 output")
+    p.add_argument("--img-size", type=int, default=128)
+    p.add_argument("--config", help="JSON FedConfig file; its model section wins")
+    args = p.parse_args(argv)
+    if args.config:
+        from fedcrack_tpu.configs import FedConfig
+
+        with open(args.config) as f:
+            config = FedConfig.from_json(f.read()).model
+    else:
+        config = ModelConfig(img_size=args.img_size)
+    template = init_variables(jax.random.key(0), config)
+    with open(args.msgpack_path, "rb") as f:
+        variables = tree_from_bytes(f.read(), template=template)
+    export_resunet_h5(variables, args.out_path, config)
+    print(f"exported {args.msgpack_path} -> {args.out_path}")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    sys.exit(main())

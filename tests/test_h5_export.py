@@ -1,0 +1,106 @@
+"""Flax -> Keras h5 exporter: the two-way door must actually open.
+
+Two oracles: (1) export -> h5_import round-trips bit-exactly through our own
+reader; (2) REAL Keras loads the exported file via ``load_weights`` and its
+forward pass matches the Flax model — the workflow a reference user runs
+(test/Segmentation2.py:94 loads a checkpoint for inference)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fedcrack_tpu.configs import ModelConfig
+from fedcrack_tpu.models import ResUNet
+from fedcrack_tpu.models.resunet import init_variables
+from fedcrack_tpu.tools.h5_export import export_resunet_h5
+from fedcrack_tpu.tools.h5_import import import_resunet_h5
+
+TINY = ModelConfig(
+    img_size=32, stem_features=4, encoder_features=(8,), decoder_features=(8, 4)
+)
+
+
+def _random_variables(seed: int = 0) -> dict:
+    """Random params AND batch_stats so the export exercises both trees."""
+    variables = init_variables(jax.random.key(seed), TINY)
+    rng = np.random.RandomState(seed)
+
+    def perturb(x):
+        arr = np.asarray(x, np.float32)
+        return rng.normal(0.1, 0.4, arr.shape).astype(np.float32)
+
+    out = jax.tree_util.tree_map(perturb, variables)
+    # moving variance must stay positive
+    out["batch_stats"] = jax.tree_util.tree_map(
+        lambda x: np.abs(x) + 0.25, out["batch_stats"]
+    )
+    return out
+
+
+def test_export_import_round_trip_exact(tmp_path):
+    variables = _random_variables()
+    path = str(tmp_path / "export.h5")
+    export_resunet_h5(variables, path, TINY)
+    back = import_resunet_h5(path, TINY)
+    want = jax.tree_util.tree_leaves_with_path(variables)
+    got = dict(jax.tree_util.tree_leaves_with_path(back))
+    assert len(want) == len(got)
+    for key, w in want:
+        np.testing.assert_array_equal(
+            np.asarray(got[key]), np.asarray(w), err_msg=jax.tree_util.keystr(key)
+        )
+
+
+def test_real_keras_loads_export_with_forward_parity(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    from test_h5_import import build_keras_resunet
+
+    variables = _random_variables(3)
+    path = str(tmp_path / "export.h5")
+    export_resunet_h5(variables, path, TINY)
+
+    model = build_keras_resunet(TINY)
+    model.load_weights(path)
+
+    rng = np.random.RandomState(11)
+    images = rng.uniform(0, 1, (2, *TINY.input_shape)).astype(np.float32)
+    y_keras = model.predict(images, verbose=0)
+    logits = ResUNet(config=TINY).apply(variables, jnp.asarray(images), train=False)
+    y_flax = np.asarray(jax.nn.sigmoid(logits))
+    np.testing.assert_allclose(y_flax, y_keras, atol=2e-5, rtol=1e-4)
+
+
+def test_export_rejects_config_model_mismatch(tmp_path):
+    """A config declaring fewer blocks than the weights hold must raise, not
+    write a well-formed h5 with blocks silently missing."""
+    variables = _random_variables()
+    smaller = ModelConfig(
+        img_size=32, stem_features=4, encoder_features=(8,), decoder_features=(8,)
+    )
+    with pytest.raises(ValueError, match="unconsumed"):
+        export_resunet_h5(variables, str(tmp_path / "x.h5"), smaller)
+
+
+def test_cli_round_trip(tmp_path):
+    """msgpack -> h5 via the CLI entry point, then back through the importer."""
+    from fedcrack_tpu.fed.serialization import tree_to_bytes
+    from fedcrack_tpu.tools.h5_export import main
+
+    variables = _random_variables(5)
+    mp = tmp_path / "model.msgpack"
+    mp.write_bytes(tree_to_bytes(variables))
+    out = tmp_path / "model.h5"
+    # TINY is not the default 128px config: exercise --config plumbing via a
+    # FedConfig file carrying the model section.
+    from fedcrack_tpu.configs import DataConfig, FedConfig
+
+    cfg = FedConfig(model=TINY, data=DataConfig(img_size=TINY.img_size))
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(cfg.to_json())
+    assert main([str(mp), str(out), "--config", str(cfg_path)]) == 0
+    back = import_resunet_h5(str(out), TINY)
+    leaf = jax.tree_util.tree_leaves(back["params"])[0]
+    want = jax.tree_util.tree_leaves(variables["params"])[0]
+    np.testing.assert_array_equal(np.asarray(leaf), np.asarray(want))
