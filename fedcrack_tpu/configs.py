@@ -126,6 +126,13 @@ class FedConfig:
     # reference's 'L' chunk path wrote TensorBoard events under ./logs,
     # fl_server.py:84-89); empty keeps uploads in memory only.
     logs_dir: str = ""
+    # In-memory log sink caps: chunks accumulate in server memory until the
+    # uploader sends `last` (then they flush to logs_dir; with logs_dir
+    # empty they are retained in memory for checkpointing), so uploads must
+    # hit a ceiling. Per-upload and across-all-uploads, in MiB; over-cap
+    # chunks are REJECTED; 0 = uncapped. Only cohort members may upload.
+    log_max_mb_per_upload: int = 64
+    log_max_mb_total: int = 256
     # jax.profiler trace directory for training spans; empty disables.
     profile_dir: str = ""
     # Msgpack pytree seeding the initial global model (e.g. from the Keras h5

@@ -23,6 +23,16 @@ Deliberate fixes over observed reference behavior (SURVEY.md §2.2):
    crashed encoding a ``None`` reply).
 4. A round deadline shrinks the cohort to the clients that reported, so one
    dead client cannot hang the barrier forever (SURVEY.md §5.3).
+5. A deadline with ZERO reports (every cohort member died) re-opens
+   enrollment instead of stalling forever — the round counter and global
+   weights survive, a fresh cohort picks the federation back up.
+6. A cohort member that crashes and restarts mid-federation re-enrolls and
+   is re-synced to the current round (the reference turned every mid-run
+   ``Ready`` away with CTW, fl_server.py:78-81, locking the client out for
+   the rest of the run).
+7. The in-memory log sink is capped per upload and in total; over-cap
+   chunks get an explicit ``REJECTED`` (the reference streamed unbounded
+   bytes into server memory before its disk write, fl_server.py:84-89).
 """
 
 from __future__ import annotations
@@ -157,6 +167,9 @@ class ServerState:
     # config.wire_dtype == "bfloat16". Server-side consumers (eval,
     # checkpoints) always read global_blob.
     wire_blob: bytes = b""
+    # Rounds that expired with zero reports (the whole cohort died) and were
+    # recovered by re-opening enrollment — observability for fix #5.
+    failed_rounds: int = 0
 
     @property
     def broadcast_blob(self) -> bytes:
@@ -240,13 +253,26 @@ def _advance_time(state: ServerState, now: float) -> ServerState:
         and state.config.round_deadline_s > 0
         and state.round_started_at is not None
         and now - state.round_started_at > state.config.round_deadline_s
-        and state.received
         and len(state.received) < len(state.cohort)
     ):
-        # Deadline: aggregate over who reported; the missing clients are
-        # dropped from the cohort (fix #4 — the reference hung forever).
-        state = state._replace(cohort=frozenset(state.received.keys()))
-        state = _aggregate(state, now)
+        if state.received:
+            # Deadline: aggregate over who reported; the missing clients are
+            # dropped from the cohort (fix #4 — the reference hung forever).
+            state = state._replace(cohort=frozenset(state.received.keys()))
+            state = _aggregate(state, now)
+        else:
+            # Silent cohort: every enrolled client died before reporting.
+            # Re-open enrollment so a fresh cohort can resume the federation
+            # at the same round — round counter and global weights survive
+            # (fix #5; previously this stalled in PHASE_RUNNING forever,
+            # the same liveness class as the reference's barrier hang).
+            state = state._replace(
+                phase=PHASE_ENROLL,
+                cohort=frozenset(),
+                enroll_opened_at=None,
+                round_started_at=None,
+                failed_rounds=state.failed_rounds + 1,
+            )
     return state
 
 
@@ -322,6 +348,19 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
             if state.phase == PHASE_FINISHED:
                 return state, Reply(status=FIN, config=_ready_config(state, FIN))
             if state.phase == PHASE_RUNNING:
+                if cname in state.cohort:
+                    # A cohort member that crashed and restarted: re-sync it
+                    # with the current round instead of locking it out
+                    # (fix #6). Its pre-crash report for this round, if any,
+                    # is dropped — the client is redoing the round, and a
+                    # barrier completed by the stale blob would advance the
+                    # round underneath it, turning its fresh report into a
+                    # REJECTED stale-round (the very lockout being fixed).
+                    if cname in state.received:
+                        received = dict(state.received)
+                        del received[cname]
+                        state = state._replace(received=received)
+                    return state, Reply(status=SW, config=_ready_config(state, SW))
                 # enrollment closed — late client turned away (fl_server.py:78-81)
                 return state, Reply(status=CTW, config=_ready_config(state, CTW))
             opened = state.enroll_opened_at if state.enroll_opened_at is not None else now
@@ -343,6 +382,14 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
             return state, Reply(status="OK", title="T")
 
         case LogChunk(cname=cname, title=title, data=data, offset=offset):
+            # Only cohort members may write into the sink — otherwise any
+            # process that can reach the port could fill the total cap and
+            # deny uploads to legitimate clients (the reference accepted
+            # 'L' chunks from anyone, fl_server.py:170-175).
+            if state.cohort and cname not in state.cohort:
+                return state, Reply(
+                    status=REJECTED, title="log upload: not in cohort"
+                )
             key = f"{cname}/{title}"
             logs = dict(state.logs)
             buf = logs.get(key, b"")
@@ -354,7 +401,32 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
             # Writing at the declared offset makes retried chunks overwrite
             # themselves rather than duplicate, and offset=0 restarts cleanly
             # after a failed or already-flushed upload.
-            logs[key] = buf[:offset] + data
+            new_buf = buf[:offset] + data
+            # Sink caps (fix #7): an upload that never sends `last` must not
+            # grow server memory without bound. Per-upload and total caps
+            # (0 = uncapped), rejected explicitly so the uploader fails
+            # loudly.
+            per_cap = state.config.log_max_mb_per_upload * 1024 * 1024
+            if per_cap > 0 and len(new_buf) > per_cap:
+                return state, Reply(
+                    status=REJECTED,
+                    title=(
+                        f"log upload {title!r} over per-upload cap: "
+                        f"{len(new_buf)} > {per_cap} bytes"
+                    ),
+                )
+            total_cap = state.config.log_max_mb_total * 1024 * 1024
+            total = len(new_buf) + sum(
+                len(v) for k, v in logs.items() if k != key
+            )
+            if total_cap > 0 and total > total_cap:
+                return state, Reply(
+                    status=REJECTED,
+                    title=(
+                        f"log sink over total cap: {total} > {total_cap} bytes"
+                    ),
+                )
+            logs[key] = new_buf
             return state._replace(logs=logs), Reply(status="OK", title=title)
 
         case TrainDone(cname=cname, round=rnd, blob=blob, num_samples=ns, now=now):

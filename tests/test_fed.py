@@ -236,6 +236,104 @@ def test_log_chunk_offsets_idempotent_and_gap_rejected():
     assert R.drop_log(state, "c", "t").logs == state.logs
 
 
+def test_silent_cohort_deadline_reopens_enrollment():
+    """Fix #5 regression: a deadline with ZERO reports (every cohort member
+    died) must re-open enrollment, not stall in PHASE_RUNNING forever."""
+    cfg = dataclasses.replace(CFG, round_deadline_s=5.0)
+    state = enroll_two(boot(cfg))
+    assert state.phase == R.PHASE_RUNNING
+    # nobody ever reports; time blows way past the deadline
+    state, _ = R.transition(state, R.Tick(now=100.0))
+    assert state.phase == R.PHASE_ENROLL
+    assert state.cohort == frozenset()
+    assert state.current_round == 1       # round counter survives
+    assert state.failed_rounds == 1
+    # a fresh cohort enrolls and completes the federation from round 1
+    state = enroll_two(state, t0=101.0)
+    state, _ = done(state, "a", 1, seed=1, now=102.0)
+    state, r = done(state, "b", 1, seed=2, now=103.0)
+    assert r.status == R.RESP_ARY
+    assert state.current_round == 2
+
+
+def test_cohort_member_rejoins_after_crash():
+    """Fix #6 regression: Ready from an enrolled cname during RUNNING
+    re-syncs the client (SW + current round) instead of locking it out."""
+    state = enroll_two(boot())
+    state, _ = done(state, "a", 1, seed=1, now=2.0)
+    # "b" crashes and restarts: its Ready mid-run must re-enroll it
+    state, r = R.transition(state, R.Ready("b", now=3.0))
+    assert r.status == R.SW
+    assert r.config["current_round"] == 1
+    assert "b" in state.cohort
+    # a true stranger still gets CTW
+    _, r = R.transition(state, R.Ready("stranger", now=3.5))
+    assert r.status == R.CTW
+    # rejoined "b" completes the round
+    state, r = done(state, "b", 1, seed=2, now=4.0)
+    assert r.status == R.RESP_ARY
+
+
+def test_rejoin_after_reporting_drops_stale_report():
+    """A member that crashed AFTER reporting must not be raced by its own
+    stale blob: rejoin drops the pre-crash report so the barrier waits for
+    the redo instead of advancing the round underneath the client."""
+    state = enroll_two(boot())
+    state, _ = done(state, "b", 1, seed=9, now=2.0)   # b reports, then crashes
+    state, r = R.transition(state, R.Ready("b", now=3.0))
+    assert r.status == R.SW
+    assert "b" not in state.received
+    # a's report alone must NOT complete the barrier now
+    state, r = done(state, "a", 1, seed=1, now=4.0)
+    assert r.status == R.RESP_ACY
+    # b's fresh report completes the round — no stale-round rejection
+    state, r = done(state, "b", 1, seed=2, now=5.0)
+    assert r.status == R.RESP_ARY
+
+
+def test_log_chunk_from_non_cohort_rejected():
+    """Only cohort members may fill the in-memory sink — anyone else could
+    exhaust the total cap and deny uploads to legitimate clients."""
+    state = enroll_two(boot())
+    _, r = R.transition(state, R.LogChunk("stranger", "t", b"x", now=2.0))
+    assert r.status == R.REJECTED and "not in cohort" in r.title
+    # before any enrollment the cohort is empty -> permissive (pre-enroll
+    # uploads are allowed; the auth layer gates unauthenticated senders)
+    s0 = boot()
+    _, r = R.transition(s0, R.LogChunk("early", "t", b"x", now=0.0))
+    assert r.status == "OK"
+
+
+def test_log_sink_cap_zero_means_uncapped():
+    cfg = dataclasses.replace(CFG, log_max_mb_per_upload=0, log_max_mb_total=0)
+    state = enroll_two(boot(cfg))
+    state, r = R.transition(
+        state, R.LogChunk("a", "t", b"x" * (2 * 1024 * 1024), now=2.0)
+    )
+    assert r.status == "OK"
+
+
+def test_log_sink_caps_enforced():
+    """Fix #7 regression: per-upload and total caps on the in-memory sink."""
+    cfg = dataclasses.replace(CFG, log_max_mb_per_upload=1, log_max_mb_total=2)
+    state = enroll_two(boot(cfg))
+    mib = 1024 * 1024
+    # per-upload cap: second MiB+1 byte of one title is rejected
+    state, r = R.transition(state, R.LogChunk("a", "big", b"x" * mib, now=2.0))
+    assert r.status == "OK"
+    _, r = R.transition(
+        state, R.LogChunk("a", "big", b"y", now=2.1, offset=mib)
+    )
+    assert r.status == R.REJECTED and "per-upload cap" in r.title
+    # total cap: two 1 MiB titles fill the sink; a third is rejected
+    state, r = R.transition(state, R.LogChunk("b", "big", b"x" * mib, now=2.2))
+    assert r.status == "OK"
+    _, r = R.transition(state, R.LogChunk("a", "more", b"z" * mib, now=2.3))
+    assert r.status == R.REJECTED and "total cap" in r.title
+    # an over-cap rejection leaves existing buffers intact
+    assert len(state.logs["a/big"]) == mib and len(state.logs["b/big"]) == mib
+
+
 class TestFedOpt:
     """Server-side optimizers on the round pseudo-gradient (FedOpt)."""
 

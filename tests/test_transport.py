@@ -194,6 +194,55 @@ def test_dead_client_mid_round_cohort_shrinks(session_cfg):
     assert state.cohort == frozenset({"a"})
 
 
+def test_crashed_client_restart_rejoins_and_completes(session_cfg):
+    """Crash-restart-rejoin e2e: a cohort member that dies mid-round restarts
+    under the same cname, re-enrolls mid-run (SW, not CTW), and the
+    federation completes with the full cohort — no deadline shrink needed."""
+    server = FedServer(session_cfg, _vars(0.0), tick_period_s=0.05)
+
+    class Crash(Exception):
+        pass
+
+    calls = {"n": 0}
+
+    def crashy_train(blob, rnd):
+        calls["n"] += 1
+        if calls["n"] == 2:  # dies during its second local fit (round 2)
+            raise Crash()
+        return _fake_train(1.0, 10)(blob, rnd)
+
+    with ServerThread(server) as st:
+        a = FedClient(session_cfg, _fake_train(1.0, 10), cname="a", port=st.port)
+        b1 = FedClient(session_cfg, crashy_train, cname="b", port=st.port)
+        res = {}
+
+        def run(c, key):
+            try:
+                res[key] = c.run_session()
+            except Exception as e:
+                res[key] = e
+
+        ta = threading.Thread(target=run, args=(a, "a"))
+        tb = threading.Thread(target=run, args=(b1, "b1"))
+        ta.start()
+        tb.start()
+        tb.join(60)
+        assert isinstance(res["b1"], Crash)
+        # restart under the same cname: must re-enroll and finish the run
+        b2 = FedClient(session_cfg, _fake_train(1.0, 10), cname="b", port=st.port)
+        run(b2, "b2")
+        ta.join(60)
+        state = st.state
+
+    assert not isinstance(res["a"], Exception)
+    assert res["b2"].enrolled, "restarted cohort member was locked out"
+    assert res["a"].rounds_completed == 3
+    assert res["b2"].rounds_completed == 3
+    assert state.phase == R.PHASE_FINISHED
+    assert state.cohort == frozenset({"a", "b"})
+    assert len(state.history) == 3
+
+
 def test_safe_component_injective():
     """Distinct untrusted wire names must never map to the same file — e.g.
     titles 'a/b' and 'a_b' previously both became 'a_b', letting one client
