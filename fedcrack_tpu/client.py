@@ -66,6 +66,18 @@ def main(argv: list[str] | None = None) -> int:
         dest="profile_dir",
         help="jax.profiler trace dir wrapping each round's local fit",
     )
+    p.add_argument(
+        "--auth-token",
+        dest="auth_token",
+        help="shared enrollment token (must match the server's)",
+    )
+    p.add_argument(
+        "--tls-ca",
+        dest="tls_ca",
+        help="root CA (PEM) to verify the server over TLS; plaintext if unset",
+    )
+    p.add_argument("--tls-cert", dest="tls_cert", help="client certificate for mTLS (PEM)")
+    p.add_argument("--tls-key", dest="tls_key", help="client private key for mTLS (PEM)")
     args = p.parse_args(argv)
 
     if args.config:
@@ -81,6 +93,10 @@ def main(argv: list[str] | None = None) -> int:
             ("metrics_path", args.metrics_path),
             ("tb_dir", args.tb_dir),
             ("profile_dir", args.profile_dir),
+            ("auth_token", args.auth_token),
+            ("tls_ca", args.tls_ca),
+            ("tls_cert", args.tls_cert),
+            ("tls_key", args.tls_key),
         ]
         if v is not None
     }

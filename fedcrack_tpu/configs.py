@@ -143,6 +143,21 @@ class FedConfig:
     # — the federated analog of the reference's best-val ModelCheckpoint
     # (test/Segmentation.py:177-179). Empty disables.
     best_path: str = ""
+    # Control-plane security. The reference's channel was fully open — no
+    # identity, no transport security; anyone reaching the port could
+    # enroll or poison the cohort (fl_client.py:181, SURVEY.md §5.8).
+    # auth_token: shared secret required on every client message when set
+    # (constant-time compared server-side; unauthenticated messages are
+    # REJECTED). Empty disables.
+    auth_token: str = ""
+    # TLS: the server serves with ssl_server_credentials when tls_cert +
+    # tls_key are both set (PEM file paths); a client connects over TLS
+    # when tls_ca is set (PEM root to verify the server). When the server
+    # also sets tls_ca, client certificates are required (mTLS) — clients
+    # then present tls_cert/tls_key. All empty = plaintext.
+    tls_cert: str = ""
+    tls_key: str = ""
+    tls_ca: str = ""
     max_message_mb: int = 512     # reference: fl_server.py:215 (both directions here)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
@@ -159,6 +174,15 @@ class FedConfig:
         if self.wire_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"wire_dtype must be float32 or bfloat16, got {self.wire_dtype!r}"
+            )
+        if bool(self.tls_cert) != bool(self.tls_key):
+            # Half a TLS identity must fail fast — otherwise the server
+            # would silently fall back to a plaintext port (and a client
+            # silently omit its mTLS certificate) while the operator
+            # believes TLS is on.
+            raise ValueError(
+                "tls_cert and tls_key must be set together; got "
+                f"tls_cert={self.tls_cert!r}, tls_key={self.tls_key!r}"
             )
 
     # ---- serialization (in-band config map + files) ----

@@ -170,6 +170,10 @@ class ServerState:
     # Rounds that expired with zero reports (the whole cohort died) and were
     # recovered by re-opening enrollment — observability for fix #5.
     failed_rounds: int = 0
+    # Cohort members dropped by a deadline shrink (fix #4). A departed
+    # member that restarts may re-admit itself via Ready (fix #6 must hold
+    # even when the crash outlives the deadline).
+    departed: frozenset[str] = frozenset()
 
     @property
     def broadcast_blob(self) -> bytes:
@@ -257,8 +261,13 @@ def _advance_time(state: ServerState, now: float) -> ServerState:
     ):
         if state.received:
             # Deadline: aggregate over who reported; the missing clients are
-            # dropped from the cohort (fix #4 — the reference hung forever).
-            state = state._replace(cohort=frozenset(state.received.keys()))
+            # dropped from the cohort (fix #4 — the reference hung forever)
+            # but remembered, so a later restart can re-admit them.
+            reported = frozenset(state.received.keys())
+            state = state._replace(
+                cohort=reported,
+                departed=state.departed | (state.cohort - reported),
+            )
             state = _aggregate(state, now)
         else:
             # Silent cohort: every enrolled client died before reporting.
@@ -361,6 +370,15 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
                         del received[cname]
                         state = state._replace(received=received)
                     return state, Reply(status=SW, config=_ready_config(state, SW))
+                if cname in state.departed:
+                    # Dropped by a deadline shrink, now back: re-admit. Fix
+                    # #6 must hold even when the restart loses the race with
+                    # the deadline — otherwise the client is CTW'd forever.
+                    state = state._replace(
+                        cohort=state.cohort | {cname},
+                        departed=state.departed - {cname},
+                    )
+                    return state, Reply(status=SW, config=_ready_config(state, SW))
                 # enrollment closed — late client turned away (fl_server.py:78-81)
                 return state, Reply(status=CTW, config=_ready_config(state, CTW))
             opened = state.enroll_opened_at if state.enroll_opened_at is not None else now
@@ -383,10 +401,11 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
 
         case LogChunk(cname=cname, title=title, data=data, offset=offset):
             # Only cohort members may write into the sink — otherwise any
-            # process that can reach the port could fill the total cap and
+            # process that can reach the port (including pre-enrollment,
+            # when the cohort is still empty) could fill the total cap and
             # deny uploads to legitimate clients (the reference accepted
             # 'L' chunks from anyone, fl_server.py:170-175).
-            if state.cohort and cname not in state.cohort:
+            if cname not in state.cohort:
                 return state, Reply(
                     status=REJECTED, title="log upload: not in cohort"
                 )

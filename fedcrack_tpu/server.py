@@ -102,6 +102,20 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         help="seed the global model from a msgpack pytree (e.g. produced by "
         "`python -m fedcrack_tpu.tools.h5_import crack_segmentation.h5 out.msgpack`)",
     )
+    p.add_argument(
+        "--auth-token",
+        dest="auth_token",
+        help="shared enrollment token: every client message must carry it "
+        "or is REJECTED (the reference accepted anyone reaching the port)",
+    )
+    p.add_argument("--tls-cert", dest="tls_cert", help="server TLS certificate (PEM)")
+    p.add_argument("--tls-key", dest="tls_key", help="server TLS private key (PEM)")
+    p.add_argument(
+        "--tls-ca",
+        dest="tls_ca",
+        help="CA bundle (PEM); on the server this also demands client "
+        "certificates (mTLS)",
+    )
     args = p.parse_args(argv)
 
     if args.config:
@@ -130,6 +144,10 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         ("logs_dir", "logs_dir"),
         ("init_weights", "init_weights"),
         ("best_path", "best_path"),
+        ("auth_token", "auth_token"),
+        ("tls_cert", "tls_cert"),
+        ("tls_key", "tls_key"),
+        ("tls_ca", "tls_ca"),
     ]:
         val = getattr(args, flag)
         if val is not None:
@@ -138,7 +156,10 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         import dataclasses
 
         cfg = dataclasses.replace(cfg, **overrides)
-    logging.info("config: %s", json.loads(cfg.to_json()))
+    shown = json.loads(cfg.to_json())
+    if shown.get("auth_token"):
+        shown["auth_token"] = "<redacted>"  # the secret must not hit logs
+    logging.info("config: %s", shown)
     return cfg, args
 
 

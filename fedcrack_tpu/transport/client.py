@@ -97,10 +97,27 @@ class FedClient:
     # -- wire helpers --
 
     def _connect(self) -> tuple[grpc.Channel, Any]:
-        channel = grpc.insecure_channel(
-            f"{self.config.host}:{self.port}",
-            options=channel_options(self.config.max_message_mb),
-        )
+        target = f"{self.config.host}:{self.port}"
+        options = channel_options(self.config.max_message_mb)
+        if self.config.tls_ca:
+            # TLS channel, verifying the server against the configured root.
+            # When the server demands client certs (mTLS), this client
+            # presents its own tls_cert/tls_key. The reference always
+            # dialed an insecure channel (fl_client.py:181).
+            with open(self.config.tls_ca, "rb") as f:
+                ca = f.read()
+            key = cert = None
+            if self.config.tls_cert and self.config.tls_key:
+                with open(self.config.tls_key, "rb") as f:
+                    key = f.read()
+                with open(self.config.tls_cert, "rb") as f:
+                    cert = f.read()
+            creds = grpc.ssl_channel_credentials(
+                root_certificates=ca, private_key=key, certificate_chain=cert
+            )
+            channel = grpc.secure_channel(target, creds, options=options)
+        else:
+            channel = grpc.insecure_channel(target, options=options)
         method = channel.stream_stream(
             f"/{SERVICE_NAME}/{METHOD}",
             request_serializer=pb.ClientMessage.SerializeToString,
@@ -131,7 +148,7 @@ class FedClient:
         raise AssertionError("unreachable")
 
     def _msg(self) -> pb.ClientMessage:
-        return pb.ClientMessage(cname=self.cname)
+        return pb.ClientMessage(cname=self.cname, token=self.config.auth_token)
 
     # -- the session --
 

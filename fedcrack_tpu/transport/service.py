@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import hmac
 import logging
 import os
 import re
@@ -284,7 +285,17 @@ class FedServer:
     async def _session(
         self, request_iterator: AsyncIterator[pb.ClientMessage], context
     ) -> AsyncIterator[pb.ServerMessage]:
+        token = self.config.auth_token
         async for msg in request_iterator:
+            if token and not hmac.compare_digest(
+                msg.token.encode("utf-8"), token.encode("utf-8")
+            ):
+                # Authentication precedes ALL protocol processing: an
+                # unauthenticated Ready/TrainDone/LogChunk never reaches the
+                # state machine (the reference accepted anything that could
+                # reach the port, fl_client.py:181).
+                yield pb.ServerMessage(status=R.REJECTED, title="unauthenticated")
+                continue
             try:
                 # Decode (and CRC-verify log chunks) off the event loop: the
                 # pure-Python CRC fallback costs ~0.3 s/MiB, which inline
@@ -346,9 +357,27 @@ class FedServer:
         server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(SERVICE_NAME, {METHOD: handler}),)
         )
-        self.bound_port = server.add_insecure_port(
-            f"{self.config.host}:{self.config.port}"
-        )
+        address = f"{self.config.host}:{self.config.port}"
+        if self.config.tls_cert and self.config.tls_key:
+            # TLS server credentials (the reference served an insecure port
+            # only, fl_server.py:218). With tls_ca set too, client certs
+            # are required — mTLS across the trust boundary.
+            with open(self.config.tls_key, "rb") as f:
+                key = f.read()
+            with open(self.config.tls_cert, "rb") as f:
+                cert = f.read()
+            ca = None
+            if self.config.tls_ca:
+                with open(self.config.tls_ca, "rb") as f:
+                    ca = f.read()
+            creds = grpc.ssl_server_credentials(
+                [(key, cert)],
+                root_certificates=ca,
+                require_client_auth=ca is not None,
+            )
+            self.bound_port = server.add_secure_port(address, creds)
+        else:
+            self.bound_port = server.add_insecure_port(address)
         return server
 
     async def start(self) -> int:

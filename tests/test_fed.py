@@ -215,6 +215,7 @@ def test_log_chunk_offsets_idempotent_and_gap_rejected():
 
     cfg = FedConfig(cohort_size=1)
     state = R.initial_state(cfg, {"params": {"w": np.zeros(2, np.float32)}})
+    state, _ = R.transition(state, R.Ready("c", now=0.0))  # uploads need cohort membership
     chunk = lambda data, off: R.LogChunk(
         cname="c", title="t", data=data, now=0.0, offset=off
     )
@@ -293,15 +294,39 @@ def test_rejoin_after_reporting_drops_stale_report():
 
 def test_log_chunk_from_non_cohort_rejected():
     """Only cohort members may fill the in-memory sink — anyone else could
-    exhaust the total cap and deny uploads to legitimate clients."""
+    exhaust the total cap and deny uploads to legitimate clients. This
+    includes pre-enrollment senders: an attacker filling the sink before
+    the cohort forms would deny every later legitimate upload."""
     state = enroll_two(boot())
     _, r = R.transition(state, R.LogChunk("stranger", "t", b"x", now=2.0))
     assert r.status == R.REJECTED and "not in cohort" in r.title
-    # before any enrollment the cohort is empty -> permissive (pre-enroll
-    # uploads are allowed; the auth layer gates unauthenticated senders)
     s0 = boot()
     _, r = R.transition(s0, R.LogChunk("early", "t", b"x", now=0.0))
-    assert r.status == "OK"
+    assert r.status == R.REJECTED
+
+
+def test_departed_member_readmitted_after_deadline_shrink():
+    """Fix #6 must hold even when the restart loses the race with the
+    deadline: a member shrunk out of the cohort re-admits itself via Ready
+    instead of being CTW'd for the rest of the federation."""
+    cfg = dataclasses.replace(CFG, round_deadline_s=5.0, max_rounds=3)
+    state = enroll_two(boot(cfg))
+    state, _ = done(state, "a", 1, seed=1, now=2.0)
+    # b misses the deadline: cohort shrinks to {a}, round 1 aggregates
+    state, _ = R.transition(state, R.Tick(now=20.0))
+    assert state.cohort == frozenset({"a"})
+    assert state.departed == frozenset({"b"})
+    assert state.current_round == 2
+    # b restarts and re-enrolls mid-run -> re-admitted, not CTW
+    state, r = R.transition(state, R.Ready("b", now=21.0))
+    assert r.status == R.SW
+    assert state.cohort == frozenset({"a", "b"})
+    assert state.departed == frozenset()
+    # round 2 now needs both again
+    state, r = done(state, "a", 2, seed=3, now=22.0)
+    assert r.status == R.RESP_ACY
+    state, r = done(state, "b", 2, seed=4, now=23.0)
+    assert r.status == R.RESP_ARY
 
 
 def test_log_sink_cap_zero_means_uncapped():

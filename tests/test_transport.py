@@ -8,6 +8,7 @@ weights == average of uploads (regression tests for the reference bugs
 import dataclasses
 import threading
 
+import grpc
 import numpy as np
 import pytest
 
@@ -243,6 +244,116 @@ def test_crashed_client_restart_rejoins_and_completes(session_cfg):
     assert len(state.history) == 3
 
 
+def test_auth_token_gates_every_message(session_cfg):
+    """Control-plane authentication: the right token completes a session;
+    a wrong (or missing) token is REJECTED at enrollment and an
+    already-authenticated flow's uploads are still checked per-message.
+    The token is deliberately non-ASCII: the comparison must be over
+    utf-8 bytes (str-domain compare_digest raises on non-ASCII)."""
+    cfg = dataclasses.replace(session_cfg, cohort_size=1, auth_token="s3crét-käy")
+    server = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
+    with ServerThread(server) as st:
+        ok = FedClient(cfg, _fake_train(1.0, 10), cname="good", port=st.port)
+        r_ok = ok.run_session()
+    assert r_ok.enrolled and r_ok.rounds_completed == 3
+
+    server2 = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
+    with ServerThread(server2) as st:
+        bad_cfg = dataclasses.replace(cfg, auth_token="wrong")
+        bad = FedClient(bad_cfg, _fake_train(1.0, 10), cname="evil", port=st.port)
+        r_bad = bad.run_session()
+        noauth_cfg = dataclasses.replace(cfg, auth_token="")
+        noauth = FedClient(
+            noauth_cfg, _fake_train(1.0, 10), cname="anon", port=st.port
+        )
+        r_noauth = noauth.run_session()
+        state = st.state
+    assert not r_bad.enrolled and not r_noauth.enrolled
+    assert state.cohort == frozenset()  # nothing reached the state machine
+
+
+def test_partial_tls_config_fails_fast():
+    """Half a TLS identity must not silently serve plaintext."""
+    with pytest.raises(ValueError, match="tls_cert and tls_key"):
+        FedConfig(tls_cert="/some/cert.pem")
+    with pytest.raises(ValueError, match="tls_cert and tls_key"):
+        FedConfig(tls_key="/some/key.pem")
+
+
+def _self_signed_cert(tmp_path):
+    """A throwaway self-signed cert for 127.0.0.1 (valid as its own CA)."""
+    import datetime
+    import ipaddress
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "127.0.0.1")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(days=1))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName(
+                [x509.IPAddress(ipaddress.ip_address("127.0.0.1"))]
+            ),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    cert_path = tmp_path / "cert.pem"
+    key_path = tmp_path / "key.pem"
+    cert_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_path.write_bytes(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        )
+    )
+    return str(cert_path), str(key_path)
+
+
+def test_tls_session_and_plaintext_refused(session_cfg, tmp_path):
+    """TLS server credentials as a config option: a TLS+token client
+    completes; a plaintext client cannot even open the stream."""
+    cert, key = _self_signed_cert(tmp_path)
+    server_cfg = dataclasses.replace(
+        session_cfg,
+        cohort_size=1,
+        max_rounds=1,
+        auth_token="s3cret",
+        tls_cert=cert,
+        tls_key=key,
+    )
+    server = FedServer(server_cfg, _vars(0.0), tick_period_s=0.05)
+    with ServerThread(server) as st:
+        # client verifies the self-signed server cert as its root
+        client_cfg = dataclasses.replace(server_cfg, tls_cert="", tls_key="", tls_ca=cert)
+        ok = FedClient(client_cfg, _fake_train(1.0, 10), cname="tls", port=st.port)
+        r_ok = ok.run_session()
+        assert r_ok.enrolled and r_ok.rounds_completed == 1
+
+    server2 = FedServer(server_cfg, _vars(0.0), tick_period_s=0.05)
+    with ServerThread(server2) as st:
+        plain_cfg = dataclasses.replace(server_cfg, tls_cert="", tls_key="")
+        plain = FedClient(
+            plain_cfg, _fake_train(1.0, 10), cname="plain", port=st.port,
+            max_retries=2, call_timeout_s=5.0,
+        )
+        with pytest.raises(grpc.RpcError):
+            plain.run_session()
+        assert st.state.cohort == frozenset()
+
+
 def test_safe_component_injective():
     """Distinct untrusted wire names must never map to the same file — e.g.
     titles 'a/b' and 'a_b' previously both became 'a_b', letting one client
@@ -280,9 +391,10 @@ def test_chunked_log_upload_roundtrip(session_cfg, tmp_path):
             cfg, _fake_train(1.0, 10), cname="a", port=st.port,
             upload_paths=(str(src),),
         )
-        # upload_file standalone, small chunks to force several messages
-        client.upload_file(str(src), title="../evil/../../escape", chunk_bytes=64 * 1024)
         result = client.run_session()  # session-end upload of upload_paths
+        # upload_file standalone (the sink only accepts cohort members, so
+        # this runs post-enrollment); small chunks to force several messages
+        client.upload_file(str(src), title="../evil/../../escape", chunk_bytes=64 * 1024)
         state = st.state
 
     assert result.rounds_completed == cfg.max_rounds
@@ -331,6 +443,11 @@ def test_corrupt_log_chunk_rejected(session_cfg, tmp_path):
 
         def call(msg):
             return next(iter(method(iter([msg]), timeout=10, wait_for_ready=True)))
+
+        # enroll first: the sink only accepts cohort members
+        ready = pb.ClientMessage(cname="a")
+        ready.ready.SetInParent()
+        assert call(ready).status == R.SW
 
         good = pb.ClientMessage(cname="a")
         good.log.title = "m"
