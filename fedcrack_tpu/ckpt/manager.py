@@ -14,9 +14,16 @@ import logging
 import os
 from typing import Any, Mapping
 
+import msgpack
+import numpy as np
 import orbax.checkpoint as ocp
 
 log = logging.getLogger("fedcrack.ckpt")
+
+# Cap on client-uploaded log bytes carried per checkpoint. Logs ride along
+# so a coordinator restart does not lose half-finished uploads, but a large
+# upload must not bloat every retained checkpoint (max_to_keep of them).
+DEFAULT_MAX_LOG_BYTES = 16 * 1024 * 1024
 
 
 @dataclasses.dataclass(frozen=True)
@@ -41,8 +48,14 @@ class FedCheckpointer:
     "latest step" is always "most recent round".
     """
 
-    def __init__(self, directory: str | os.PathLike, max_to_keep: int = 3):
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        max_to_keep: int = 3,
+        max_log_bytes: int = DEFAULT_MAX_LOG_BYTES,
+    ):
         self._dir = os.path.abspath(os.fspath(directory))
+        self._max_log_bytes = max_log_bytes
         os.makedirs(self._dir, exist_ok=True)
         self._mngr = ocp.CheckpointManager(
             self._dir,
@@ -51,19 +64,45 @@ class FedCheckpointer:
             ),
         )
 
+    def _capped_logs(self, logs: Mapping[str, bytes]) -> dict[str, bytes]:
+        """Drop largest-first until the total fits the per-checkpoint cap —
+        a multi-MB upload must not multiply across every retained step."""
+        out = dict(logs)
+        total = sum(len(v) for v in out.values())
+        if total <= self._max_log_bytes:
+            return out
+        for k in sorted(out, key=lambda k: len(out[k]), reverse=True):
+            if total <= self._max_log_bytes:
+                break
+            total -= len(out[k])
+            log.warning(
+                "dropping log buffer %r (%d bytes) from the checkpoint: "
+                "total log bytes exceed the %d-byte per-checkpoint cap "
+                "(the upload itself is unaffected)",
+                k, len(out[k]), self._max_log_bytes,
+            )
+            del out[k]
+        return out
+
     def save(self, ckpt: FedCheckpoint) -> None:
         meta = {
             "current_round": ckpt.current_round,
             "model_version": ckpt.model_version,
             "history": list(ckpt.history),
-            "logs": {
-                k: base64.b64encode(v).decode("ascii") for k, v in ckpt.logs.items()
-            },
         }
         items = {
             "variables": ocp.args.StandardSave(ckpt.variables),
             "meta": ocp.args.JsonSave(meta),
         }
+        logs = self._capped_logs(ckpt.logs)
+        if logs:
+            # Binary sidecar item, NOT base64 inside the JSON metadata: a
+            # JSON round-trip of megabytes of b64 costs 4/3 the bytes and
+            # a full parse on every restore.
+            packed = msgpack.packb(logs, use_bin_type=True)
+            items["logs"] = ocp.args.StandardSave(
+                {"packed": np.frombuffer(packed, np.uint8)}
+            )
         if ckpt.server_opt_state is not None:
             items["opt_state"] = ocp.args.StandardSave(ckpt.server_opt_state)
         self._mngr.save(ckpt.model_version, args=ocp.args.Composite(**items))
@@ -96,10 +135,22 @@ class FedCheckpointer:
             model_version=int(meta["model_version"]),
             variables=restored["variables"],
             history=tuple(meta.get("history", [])),
-            logs={
-                k: base64.b64decode(v) for k, v in meta.get("logs", {}).items()
-            },
+            logs=self._restore_logs(step, meta),
         )
+
+    def _restore_logs(self, step: int, meta: Mapping[str, Any]) -> dict[str, bytes]:
+        if "logs" in meta:
+            # checkpoints written before the binary sidecar carried base64
+            # inside the JSON metadata
+            return {k: base64.b64decode(v) for k, v in meta["logs"].items()}
+        try:
+            restored = self._mngr.restore(
+                step, args=ocp.args.Composite(logs=ocp.args.StandardRestore())
+            )
+        except (KeyError, FileNotFoundError, ValueError):
+            return {}  # step carries no log uploads
+        packed = np.asarray(restored["logs"]["packed"], np.uint8).tobytes()
+        return msgpack.unpackb(packed, raw=False)
 
     def restore_opt_state(self, opt_template: Any) -> Any | None:
         """Restore the FedOpt server-optimizer moments of the latest step

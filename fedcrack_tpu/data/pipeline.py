@@ -142,10 +142,15 @@ def load_example(
     (client_fit_model.py:12).
 
     ``transport_dtype="uint8"`` keeps the resized uint8 bytes (images RGB u8,
-    masks {0,1} u8) for device-side normalization via :func:`as_model_batch`
-    — bit-identical to the float32 path because the resize happens in uint8
-    either way. Falls back to float32 on the PIL path (whose native resize
-    is float-domain).
+    masks {0,1} u8) for device-side normalization via :func:`as_model_batch`.
+    Honored on BOTH decode backends: cv2 resizes in uint8 natively, and the
+    PIL path uses the native uint8-domain kernel (round-to-nearest), so the
+    1/4-staging-bytes property never silently degrades with OpenCV absent.
+    On the cv2 path the float32 variant is computed from the same uint8
+    bytes, so the two transport dtypes are bit-identical after on-device
+    normalization; on the PIL path the float32 variant interpolates in
+    float, so uint8 transport differs from it by at most the 1/510
+    quantization step (masks are bit-identical on both backends).
     """
     cv2 = _cv2()
     want_u8 = transport_dtype == "uint8"
@@ -171,11 +176,17 @@ def load_example(
 
     with Image.open(image_path) as im:
         rgb = np.asarray(im.convert("RGB"), np.uint8)
-    image = native.resize_normalize(rgb, img_size)
     with Image.open(mask_path) as im:
         gray = np.asarray(im.convert("L"), np.uint8)
-    mask = native.resize_binarize(gray, img_size)
-    return image, mask
+    if want_u8:
+        return (
+            native.resize_u8(rgb, img_size),
+            native.resize_binarize_u8(gray, img_size),
+        )
+    return (
+        native.resize_normalize(rgb, img_size),
+        native.resize_binarize(gray, img_size),
+    )
 
 
 def _num_batches(n_samples: int, batch_size: int, drop_last: bool) -> int:
@@ -232,9 +243,10 @@ class CrackDataset:
         self.num_workers = num_workers
         self.prefetch = prefetch
         self.drop_last = drop_last
-        # uint8 requires the cv2 decode path (the PIL fallback resizes in
-        # float); degrade to float32 transport rather than failing decode.
-        self.transport_dtype = transport_dtype if _cv2() is not None else "float32"
+        # uint8 is honored on both decode backends (cv2's native u8 resize,
+        # or the first-party uint8-domain kernel on the PIL path) — no
+        # silent downgrade with OpenCV absent.
+        self.transport_dtype = transport_dtype
         self._epoch = 0
 
     def __len__(self) -> int:

@@ -10,6 +10,9 @@ exposes:
   client_fit_model.py:12); when cv2 IS present the pipeline prefers its
   AVX2 fixed-point resize, which benchmarks ~1.4x faster than this scalar
   float kernel.
+- :func:`resize_u8` / :func:`resize_binarize_u8` — uint8-domain variants
+  (round-to-nearest) backing ``transport_dtype="uint8"`` (1/4 staging
+  bytes) when cv2 is absent.
 - :func:`weighted_accumulate` / :func:`scale_inplace` — host-plane FedAvg
   primitives over flat float32 buffers (OpenMP, GIL released);
 - :func:`crc32c` — hardware (SSE4.2) Castagnoli checksum for chunked-upload
@@ -148,6 +151,11 @@ def _load():
             ctypes.c_int, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
             ctypes.c_float, ctypes.c_int, ctypes.c_float,
         ]
+        lib.fedcrack_resize_u8_u8.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_float,
+        ]
         lib.fedcrack_weighted_accumulate_f32.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_float, ctypes.c_size_t,
         ]
@@ -159,7 +167,7 @@ def _load():
         ]
         lib.fedcrack_crc32c.restype = ctypes.c_uint32
         lib.fedcrack_abi_version.restype = ctypes.c_int
-        if lib.fedcrack_abi_version() != 1:
+        if lib.fedcrack_abi_version() != 2:
             log.warning("native ABI mismatch; using fallbacks")
             AVAILABLE = False
             return None
@@ -206,6 +214,39 @@ def resize_binarize(image: np.ndarray, size: int, thresh: float = 0.5) -> np.nda
     so a pixel survives iff the float interpolation is >= 0.5 — keeping the
     cv2 and native decode paths label-identical at mask boundaries."""
     out = _resize(image, size, 1.0, True, thresh)
+    return out if out.shape[-1] == 1 else out[..., :1]
+
+
+def _resize_u8(image: np.ndarray, size: int, binarize: bool,
+               thresh: float) -> np.ndarray:
+    lib = _load()
+    src = _as_u8_3d(image)
+    h, w, ch = src.shape
+    if lib is None:
+        v = _resize_numpy(src, size, 1.0, binarize, thresh)
+        # kRound semantics of the native kernel: floor(v + 0.5)
+        return np.floor(v + np.float32(0.5)).astype(np.uint8)
+    dst = np.empty((size, size, ch), np.uint8)
+    lib.fedcrack_resize_u8_u8(
+        src.ctypes.data, 1, h, w, ch, dst.ctypes.data, size, size,
+        int(binarize), ctypes.c_float(thresh),
+    )
+    return dst
+
+
+def resize_u8(image: np.ndarray, size: int) -> np.ndarray:
+    """uint8 HxWxC -> uint8 size x size x C; bilinear, rounded to nearest —
+    the uint8-transport decode path (the device applies the /255, see
+    data.pipeline.as_model_batch). The cv2-free analog of the reference's
+    uint8-domain resize (client_fit_model.py:30-38)."""
+    return _resize_u8(image, size, False, 0.0)
+
+
+def resize_binarize_u8(image: np.ndarray, size: int, thresh: float = 0.5) -> np.ndarray:
+    """uint8 HxW[x1] -> uint8 {0,1} size x size x 1 mask for uint8 transport;
+    same interpolation + threshold as :func:`resize_binarize`, so the mask
+    labels are bit-identical across the two transport dtypes."""
+    out = _resize_u8(image, size, True, thresh)
     return out if out.shape[-1] == 1 else out[..., :1]
 
 

@@ -31,16 +31,17 @@
 #include <nmmintrin.h>
 #endif
 
-extern "C" {
-
-// ---- fused bilinear resize, uint8 -> float32 ----
+// ---- fused bilinear resize, uint8 -> float32 / uint8 ----
 //
-// src: [sh, sw, ch] uint8 (C-contiguous), dst: [dh, dw, ch] float32.
+// src: [sh, sw, ch] uint8 (C-contiguous), dst: [dh, dw, ch] OutT.
 // Each output value is bilinear(src) * scale + (binarize ? threshold step).
-// With binarize != 0, output is 1.0f when the interpolated value > thresh
+// With binarize != 0, output is 1 when the interpolated value > thresh
 // (the reference's mask contract: resize then `> 0`, client_fit_model.py:41).
+// kRound (the uint8 output path) rounds to nearest like cv2's fixed-point
+// u8 resize, so uint8 transport exists without OpenCV.
+template <typename OutT, bool kRound>
 static void resize_one(const uint8_t* src, int sh, int sw, int ch,
-                       float* dst, int dh, int dw, float scale,
+                       OutT* dst, int dh, int dw, float scale,
                        int binarize, float thresh) {
   const float ry = static_cast<float>(sh) / static_cast<float>(dh);
   const float rx = static_cast<float>(sw) / static_cast<float>(dw);
@@ -67,7 +68,7 @@ static void resize_one(const uint8_t* src, int sh, int sw, int ch,
     const int y1 = std::min(y0 + 1, sh - 1);
     const float wy = fy - static_cast<float>(y0);
     const float omwy = 1.0f - wy;
-    float* out_row = dst + static_cast<size_t>(y) * dw * ch;
+    OutT* out_row = dst + static_cast<size_t>(y) * dw * ch;
     const uint8_t* row0 = src + static_cast<size_t>(y0) * sw * ch;
     const uint8_t* row1 = src + static_cast<size_t>(y1) * sw * ch;
     for (int x = 0; x < dw; ++x) {
@@ -81,8 +82,9 @@ static void resize_one(const uint8_t* src, int sh, int sw, int ch,
       for (int c = 0; c < ch; ++c) {
         const float v = w00 * row0[x0 + c] + w01 * row0[x1 + c] +
                         w10 * row1[x0 + c] + w11 * row1[x1 + c];
+        const float o = binarize ? (v > thresh ? 1.0f : 0.0f) : v * scale;
         out_row[x * ch + c] =
-            binarize ? (v > thresh ? 1.0f : 0.0f) : v * scale;
+            kRound ? static_cast<OutT>(o + 0.5f) : static_cast<OutT>(o);
       }
     }
   }
@@ -93,20 +95,39 @@ static void resize_one(const uint8_t* src, int sh, int sw, int ch,
 }
 
 // Batched entry: src [n, sh, sw, ch] uint8 -> dst [n, dh, dw, ch] float32.
-void fedcrack_resize_u8_f32(const uint8_t* src, int n, int sh, int sw, int ch,
+extern "C" void fedcrack_resize_u8_f32(const uint8_t* src, int n, int sh, int sw, int ch,
                             float* dst, int dh, int dw, float scale,
                             int binarize, float thresh) {
   const size_t src_stride = static_cast<size_t>(sh) * sw * ch;
   const size_t dst_stride = static_cast<size_t>(dh) * dw * ch;
 #pragma omp parallel for schedule(dynamic) if (n > 1)
   for (int i = 0; i < n; ++i) {
-    resize_one(src + i * src_stride, sh, sw, ch, dst + i * dst_stride, dh, dw,
-               scale, binarize, thresh);
+    resize_one<float, false>(src + i * src_stride, sh, sw, ch,
+                             dst + i * dst_stride, dh, dw, scale, binarize,
+                             thresh);
+  }
+}
+
+// Batched uint8-domain entry: src [n, sh, sw, ch] uint8 -> dst uint8.
+// Images (binarize=0, scale=1): bilinear rounded to nearest — the resized
+// transport bytes the device normalizes with /255. Masks (binarize=1):
+// {0,1} uint8. Keeps transport_dtype="uint8" (1/4 staging bytes) available
+// without OpenCV.
+extern "C" void fedcrack_resize_u8_u8(const uint8_t* src, int n, int sh, int sw, int ch,
+                           uint8_t* dst, int dh, int dw,
+                           int binarize, float thresh) {
+  const size_t src_stride = static_cast<size_t>(sh) * sw * ch;
+  const size_t dst_stride = static_cast<size_t>(dh) * dw * ch;
+#pragma omp parallel for schedule(dynamic) if (n > 1)
+  for (int i = 0; i < n; ++i) {
+    resize_one<uint8_t, true>(src + i * src_stride, sh, sw, ch,
+                              dst + i * dst_stride, dh, dw, 1.0f, binarize,
+                              thresh);
   }
 }
 
 // ---- host-plane FedAvg accumulate: acc += w * x ----
-void fedcrack_weighted_accumulate_f32(float* acc, const float* x, float w,
+extern "C" void fedcrack_weighted_accumulate_f32(float* acc, const float* x, float w,
                                       size_t n) {
 #pragma omp parallel for simd schedule(static)
   for (size_t i = 0; i < n; ++i) {
@@ -115,7 +136,7 @@ void fedcrack_weighted_accumulate_f32(float* acc, const float* x, float w,
 }
 
 // in-place scale: acc *= s (the final divide of the weighted mean)
-void fedcrack_scale_f32(float* acc, float s, size_t n) {
+extern "C" void fedcrack_scale_f32(float* acc, float s, size_t n) {
 #pragma omp parallel for simd schedule(static)
   for (size_t i = 0; i < n; ++i) {
     acc[i] *= s;
@@ -144,7 +165,7 @@ static const uint32_t* crc32c_table() {
   return tbl.t;
 }
 
-uint32_t fedcrack_crc32c(const uint8_t* data, size_t len, uint32_t init) {
+extern "C" uint32_t fedcrack_crc32c(const uint8_t* data, size_t len, uint32_t init) {
   uint32_t crc = ~init;
 #if defined(__SSE4_2__)
   while (len >= 8) {
@@ -167,6 +188,5 @@ uint32_t fedcrack_crc32c(const uint8_t* data, size_t len, uint32_t init) {
   return ~crc;
 }
 
-int fedcrack_abi_version() { return 1; }
+extern "C" int fedcrack_abi_version() { return 2; }
 
-}  // extern "C"

@@ -68,6 +68,42 @@ def test_save_restore_round_trip(tmp_path):
     assert_trees_equal(restored.variables, variables)
 
 
+def test_log_buffers_ride_binary_sidecar_with_cap(tmp_path):
+    """Accumulated log uploads are checkpointed as a binary item, never as
+    base64 inside the JSON metadata; a multi-MB buffer keeps the metadata
+    file proportionate, and buffers over the cap are dropped largest-first
+    (the checkpoint stays valid — the live upload is unaffected)."""
+    variables = tiny_variables()
+    big = bytes(range(256)) * (4 * 4096)   # 4 MiB
+    small = b"metrics\n" * 100
+    with FedCheckpointer(tmp_path / "ckpt") as ckptr:
+        ckptr.save(
+            FedCheckpoint(
+                1, 1, variables, logs={"a/big.bin": big, "a/metrics.jsonl": small}
+            )
+        )
+        restored = ckptr.restore(template=variables)
+    assert restored.logs == {"a/big.bin": big, "a/metrics.jsonl": small}
+    # the JSON metadata stays small — the bytes live in the binary item
+    metas = [p for p in (tmp_path / "ckpt").rglob("*") if p.is_file() and "meta" in str(p)]
+    assert metas, "expected a metadata file in the checkpoint layout"
+    assert all(p.stat().st_size < 64 * 1024 for p in metas), [
+        (str(p), p.stat().st_size) for p in metas
+    ]
+
+    # over-cap: the big buffer is dropped, the small one survives
+    with FedCheckpointer(
+        tmp_path / "capped", max_log_bytes=1024 * 1024
+    ) as ckptr:
+        ckptr.save(
+            FedCheckpoint(
+                1, 1, variables, logs={"a/big.bin": big, "a/metrics.jsonl": small}
+            )
+        )
+        restored = ckptr.restore(template=variables)
+    assert restored.logs == {"a/metrics.jsonl": small}
+
+
 def test_restore_empty_dir_returns_none(tmp_path):
     with FedCheckpointer(tmp_path / "empty") as ckptr:
         assert ckptr.restore() is None

@@ -228,6 +228,32 @@ def test_uint8_transport_bit_identical(fixture_dirs):
         np.testing.assert_array_equal(np.asarray(nm), fm)
 
 
+def test_uint8_transport_without_cv2(fixture_dirs, monkeypatch):
+    """The 1/4-staging-bytes property must hold with OpenCV absent: the PIL
+    path decodes uint8 transport via the native uint8-domain resize instead
+    of silently degrading to float32."""
+    from fedcrack_tpu.data import as_model_batch, pipeline
+
+    monkeypatch.setattr(pipeline, "_CV2", None)
+    monkeypatch.setattr(pipeline, "_CV2_PROBED", True)
+    image_dir, mask_dir = fixture_dirs
+    pairs = list_pairs(image_dir, mask_dir)
+    f32 = CrackDataset(pairs, img_size=64, batch_size=4, shuffle=False,
+                       num_workers=0)
+    u8 = CrackDataset(pairs, img_size=64, batch_size=4, shuffle=False,
+                      num_workers=0, transport_dtype="uint8")
+    assert u8.transport_dtype == "uint8"  # no silent downgrade
+    for (fi, fm), (ui, um) in zip(f32, u8):
+        assert ui.dtype == np.uint8 and um.dtype == np.uint8
+        assert ui.nbytes == fi.nbytes // 4
+        ni, nm = as_model_batch(ui, um)
+        # the float path interpolates in float; uint8 transport quantizes to
+        # the nearest uint8 step — within half a step after /255
+        np.testing.assert_allclose(np.asarray(ni), fi, atol=0.5 / 255.0 + 1e-6)
+        # mask labels are bit-identical across transport dtypes
+        np.testing.assert_array_equal(np.asarray(nm), fm)
+
+
 def test_train_and_eval_steps_accept_uint8_batches():
     """A uint8 transport batch must train/evaluate the same as its float32
     equivalent — normalization happens inside the jitted step. The staged

@@ -50,6 +50,39 @@ def test_resize_binarize_sparse_mask_threshold():
     np.testing.assert_array_equal(out, ref)
 
 
+def test_resize_u8_rounds_to_nearest(rng):
+    img = rng.randint(0, 256, (97, 203, 3), np.uint8)
+    out = native.resize_u8(img, 64)
+    assert out.shape == (64, 64, 3) and out.dtype == np.uint8
+    ref = native._resize_numpy(img, 64, 1.0, False, 0.0)
+    # reassociation in the native inner product can move a value across a
+    # rounding boundary vs the numpy oracle — never more than one step
+    diff = np.abs(out.astype(np.int16) - np.floor(ref + 0.5).astype(np.int16))
+    assert diff.max() <= 1
+    assert (diff > 0).mean() < 0.01  # and only at boundaries
+
+
+def test_resize_binarize_u8_matches_float_labels(rng):
+    """Mask labels must be bit-identical across the two transport dtypes —
+    same interpolation, same threshold, only the output dtype differs."""
+    m = (rng.uniform(size=(80, 70)) > 0.6).astype(np.uint8) * 255
+    u8 = native.resize_binarize_u8(m, 64)
+    f32 = native.resize_binarize(m, 64)
+    assert u8.shape == (64, 64, 1) and u8.dtype == np.uint8
+    np.testing.assert_array_equal(u8.astype(np.float32), f32)
+    assert set(np.unique(u8)).issubset({0, 1})
+
+
+def test_resize_u8_tracks_cv2(rng):
+    cv2 = pytest.importorskip("cv2")
+    img = rng.randint(0, 256, (448, 448, 3), np.uint8)
+    out = native.resize_u8(img, 128)
+    ref = cv2.resize(img, (128, 128))
+    # cv2's 11-bit fixed-point weights vs float: a few LSB, never structure
+    diff = np.abs(out.astype(np.int16) - ref.astype(np.int16))
+    assert diff.max() <= 3
+
+
 def test_resize_tracks_cv2_within_fixed_point_rounding(rng):
     cv2 = pytest.importorskip("cv2")
     img = rng.randint(0, 256, (448, 448, 3), np.uint8)
