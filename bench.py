@@ -218,6 +218,10 @@ def main() -> None:
             sweep[f"{dtype}_{img}"] = {
                 "dtype": dtype,
                 "img_size": img,
+                # raw (unrounded) seconds: every derived ratio reads these,
+                # so display rounding never leaks into the arithmetic
+                "round_s_raw": short_s,
+                "per_step_s_raw": step_s,
                 "round_ms": round(short_s * 1e3, 2),
                 "per_step_ms": round(step_s * 1e3, 3) if fit_ok else None,
                 "naive_per_step_ms": round(short_s / STEPS * 1e3, 3),
@@ -236,23 +240,21 @@ def main() -> None:
 
     f32_key = f"float32_{SIZES[0]}"
     bf16_key = f"bfloat16_{SIZES[0]}"
-    mesh_f32_s = sweep[f32_key]["round_ms"] / 1e3
-    mesh_bf16_s = sweep[bf16_key]["round_ms"] / 1e3
+    mesh_f32_s = sweep[f32_key]["round_s_raw"]
+    mesh_bf16_s = sweep[bf16_key]["round_s_raw"]
 
-    def _step_ms(point):
-        """Slope-based per-step time, falling back to naive when the fit
-        failed (the fallback overstates compute, so derived ratios degrade
-        conservatively rather than crashing)."""
-        return (
-            point["per_step_ms"]
-            if point["per_step_ms"] is not None
-            else point["naive_per_step_ms"]
-        )
+    def _step_s(point):
+        """Slope-based per-step seconds (raw), falling back to naive when
+        the fit failed (the fallback overstates compute, so derived ratios
+        degrade conservatively rather than crashing)."""
+        if point["per_step_s_raw"] is not None:
+            return point["per_step_s_raw"]
+        return point["round_s_raw"] / STEPS
 
     # Dispatch-free round times (slope x steps): the apples-to-apples basis
     # for any ratio whose other side excludes dispatch.
-    mesh_f32_compute_s = STEPS * _step_ms(sweep[f32_key]) / 1e3
-    mesh_bf16_compute_s = STEPS * _step_ms(sweep[bf16_key]) / 1e3
+    mesh_f32_compute_s = STEPS * _step_s(sweep[f32_key])
+    mesh_bf16_compute_s = STEPS * _step_s(sweep[bf16_key])
 
     # ---- host plane (reference architecture) at the reference's shape ----
     host_total_s, host_parts = _measure_host_plane(
@@ -261,7 +263,7 @@ def main() -> None:
     # Compute-only reconstruction of a host round: the same SGD step costs
     # what the mesh plane's scan charges per step (identical XLA program);
     # everything above that is the host architecture's own overhead.
-    compute_s = n_clients * STEPS * (_step_ms(sweep[f32_key]) / 1e3)
+    compute_s = n_clients * STEPS * _step_s(sweep[f32_key])
     ser_s = host_parts["serialization_ms"] / 1e3
     agg_s = host_parts["host_fedavg_ms"] / 1e3
     dispatch_s = max(0.0, host_total_s - compute_s - ser_s - agg_s)
@@ -273,7 +275,7 @@ def main() -> None:
             "dtype": "float32",
             "img_size": SIZES[0],
             "round_ms": round(host_total_s * 1e3, 2),
-            "per_step_compute_ms": _step_ms(sweep[f32_key]),
+            "per_step_compute_ms": round(_step_s(sweep[f32_key]) * 1e3, 3),
             "serialization_ms": round(host_parts["serialization_ms"], 2),
             "host_fedavg_ms": round(host_parts["host_fedavg_ms"], 2),
             "dispatch_overhead_ms": round(dispatch_s * 1e3, 2),

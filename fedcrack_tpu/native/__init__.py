@@ -146,6 +146,17 @@ def _load():
             log.warning("native library load failed (%s); using fallbacks", e)
             AVAILABLE = False
             return None
+        # ABI gate FIRST: a stale/foreign library that loads but predates the
+        # current ABI must degrade before any symbol lookup can raise.
+        try:
+            lib.fedcrack_abi_version.restype = ctypes.c_int
+            abi = lib.fedcrack_abi_version()
+        except AttributeError:
+            abi = None
+        if abi != 2:
+            log.warning("native ABI mismatch (%r); using fallbacks", abi)
+            AVAILABLE = False
+            return None
         lib.fedcrack_resize_u8_f32.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
@@ -166,11 +177,6 @@ def _load():
             ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint32,
         ]
         lib.fedcrack_crc32c.restype = ctypes.c_uint32
-        lib.fedcrack_abi_version.restype = ctypes.c_int
-        if lib.fedcrack_abi_version() != 2:
-            log.warning("native ABI mismatch; using fallbacks")
-            AVAILABLE = False
-            return None
         _lib = lib
         AVAILABLE = True
         return _lib

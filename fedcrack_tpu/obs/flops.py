@@ -27,9 +27,13 @@ from fedcrack_tpu.configs import ModelConfig
 # FLOPs ≈ 3x forward. Optimizer/BN/loss work is elementwise and excluded.
 TRAIN_STEP_FLOPS_MULTIPLIER = 3.0
 
-# Per-chip dense peak (TFLOP/s, bf16 on the MXU), keyed by substrings of
-# jax.Device.device_kind. Override with FEDCRACK_PEAK_TFLOPS for kinds not
-# listed (e.g. new hardware or a tunnel that reports an opaque kind).
+# Per-jax.Device dense peak (TFLOP/s, bf16 on the MXU), keyed by substrings
+# of jax.Device.device_kind. On v4+/v5e/v6e JAX exposes one device per chip,
+# so these are per-chip numbers. On v2/v3 JAX exposes one device per CORE
+# (two cores per chip), so those rows are per-core (half the often-quoted
+# per-chip figure) to keep mfu() honest at jax.Device granularity. Override
+# with FEDCRACK_PEAK_TFLOPS for kinds not listed (e.g. new hardware or a
+# tunnel that reports an opaque kind).
 _PEAK_TFLOPS_BF16 = (
     ("v6e", 918.0),
     ("v6 lite", 918.0),
@@ -39,8 +43,8 @@ _PEAK_TFLOPS_BF16 = (
     ("v5lite", 197.0),
     ("v4i", 138.0),
     ("v4", 275.0),
-    ("v3", 123.0),
-    ("v2", 45.0),
+    ("v3", 61.5),   # per core: 123 TFLOP/s per chip / 2 cores
+    ("v2", 22.5),   # per core: 45 TFLOP/s per chip / 2 cores
 )
 
 
@@ -95,7 +99,10 @@ def train_step_flops(config: ModelConfig | None = None, batch_size: int = 1) -> 
 
 
 def device_peak_flops(device: jax.Device | None = None) -> float | None:
-    """Per-chip bf16 dense peak in FLOP/s, or None when the kind is unknown.
+    """Per-``jax.Device`` bf16 dense peak in FLOP/s, or None when the kind
+    is unknown. One device = one chip on v4+/v5e/v6e, one CORE on v2/v3
+    (see the table above), so dividing achieved FLOP/s on one device by
+    this is always apples-to-apples.
 
     ``FEDCRACK_PEAK_TFLOPS`` overrides (useful behind device tunnels whose
     ``device_kind`` string is opaque).
