@@ -49,6 +49,14 @@ SIZES = tuple(
 )
 SEED = 0
 
+# Reference-scale round (the reference's actual workload: 10 local epochs x
+# ~388 steps of batch 16 over 6213 images, client_fit_model.py:166,76).
+# "auto" runs it on TPU only — at 3,880 steps a CPU smoke run would take
+# hours; "1"/"0" force it on/off.
+REF_EPOCHS = int(os.environ.get("FEDCRACK_BENCH_REF_EPOCHS", "10"))
+REF_STEPS = int(os.environ.get("FEDCRACK_BENCH_REF_STEPS", "388"))
+REF_SCALE = os.environ.get("FEDCRACK_BENCH_REF_SCALE", "auto")
+
 
 def _median_time(fn, reps: int = REPS) -> float:
     times = []
@@ -159,7 +167,127 @@ def _measure_host_plane(n_clients, variables, per_client, state0):
     fedavg_s = _median_time(
         lambda: jax.block_until_ready(fedavg(trees, weights=[1.0] * n_clients))
     )
-    return total_s, {"serialization_ms": ser_s * 1e3, "host_fedavg_ms": fedavg_s * 1e3}
+    return total_s, {
+        "serialization_ms": ser_s * 1e3,
+        "host_fedavg_ms": fedavg_s * 1e3,
+        # raw per-operation costs, so reconstructions at OTHER client counts
+        # (the 1-client reference-scale round) can rebuild serialization for
+        # their own shape instead of inheriting this n_clients' total
+        "to_bytes_s_raw": to_s,
+        "from_bytes_s_raw": from_s,
+        "fedavg_s_raw": fedavg_s,
+    }
+
+
+def _bench_reference_scale(img: int, dtype: str, device) -> dict:
+    """One-program federated round at the reference's true workload:
+    REF_EPOCHS local epochs over REF_STEPS batches of BATCH, single client,
+    uint8 transport staging.
+
+    Decomposition reported:
+    - ``staging_ms``: host->device transfer of one epoch's uint8 data,
+      synced via an on-device element readback (tunnel-safe barrier);
+    - ``round_ms``: the chained round program on pre-staged data — at
+      ~REF_EPOCHS*REF_STEPS steps the fixed dispatch cost is <2% of the
+      round, so the naive per-step division is finally honest;
+    - ``round_plus_restage_ms``: the round dispatched asynchronously while
+      the NEXT round's data stages concurrently (double buffering) — the
+      production overlap pattern; ``staging_hidden_frac`` is how much of
+      the staging cost the overlap hides.
+    """
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from fedcrack_tpu.configs import ModelConfig
+    from fedcrack_tpu.data.synthetic import synth_crack_batch
+    from fedcrack_tpu.obs.flops import mfu, train_step_flops
+    from fedcrack_tpu.parallel import build_federated_round, make_mesh
+    from fedcrack_tpu.train.local import create_train_state
+
+    config = ModelConfig(img_size=img, compute_dtype=dtype)
+    state0 = create_train_state(jax.random.key(SEED), config)
+    mesh = make_mesh(1, 1)
+    round_fn = build_federated_round(
+        mesh, config, learning_rate=1e-3, local_epochs=REF_EPOCHS
+    )
+    # One epoch of uint8 transport data. 512 distinct syntheses cycled to
+    # the full epoch: timing is value-independent, and 6k unique 256 px
+    # syntheses would dominate host time for no fidelity gain.
+    n_unique = min(512, REF_STEPS * BATCH)
+    imgs_f, msks_f = synth_crack_batch(n_unique, img_size=img, seed=SEED)
+    imgs_u8 = np.clip(np.rint(imgs_f * 255.0), 0, 255).astype(np.uint8)
+    msks_u8 = msks_f.astype(np.uint8)
+    need = REF_STEPS * BATCH
+    idx = np.resize(np.arange(n_unique), need)
+    images = np.ascontiguousarray(
+        imgs_u8[idx].reshape(1, REF_STEPS, BATCH, img, img, 3)
+    )
+    masks = np.ascontiguousarray(
+        msks_u8[idx].reshape(1, REF_STEPS, BATCH, img, img, 1)
+    )
+    sharding = NamedSharding(mesh, P("clients", None, "batch"))
+
+    def stage():
+        si = jax.device_put(images, sharding)
+        sm = jax.device_put(masks, sharding)
+        # On-device element readback: the computation must wait for the
+        # transfer, and the scalar fetch is a real tunnel round-trip
+        # (block_until_ready alone has been observed returning early).
+        float(jnp.asarray(si[0, 0, 0, 0, 0, 0], jnp.float32))
+        float(jnp.asarray(sm[0, 0, 0, 0, 0, 0], jnp.float32))
+        return si, sm
+
+    active = np.ones(1, np.float32)
+    n_samp = np.full(1, float(need), np.float32)
+    state = {"v": state0.variables}
+    si, sm = stage()
+
+    def run_round(imgs_dev, msks_dev):
+        new_vars, metrics = round_fn(state["v"], imgs_dev, msks_dev, active, n_samp)
+        state["v"] = new_vars
+        float(np.asarray(metrics["loss"])[0])
+
+    run_round(si, sm)  # compile + first execution
+    reps = max(1, min(REPS, 2))
+    round_s = _median_time(lambda: run_round(si, sm), reps=reps)
+    stage_s = _median_time(lambda: stage(), reps=2)
+
+    def overlapped():
+        # Dispatch the round (async), stage the next round's buffers while
+        # the device computes, then barrier both.
+        new_vars, metrics = round_fn(state["v"], si, sm, active, n_samp)
+        state["v"] = new_vars
+        si2 = jax.device_put(images, sharding)
+        sm2 = jax.device_put(masks, sharding)
+        float(jnp.asarray(si2[0, 0, 0, 0, 0, 0], jnp.float32))
+        float(jnp.asarray(sm2[0, 0, 0, 0, 0, 0], jnp.float32))
+        float(np.asarray(metrics["loss"])[0])
+
+    overlapped()  # warm the overlap path
+    overlap_s = _median_time(overlapped, reps=reps)
+
+    total_steps = REF_EPOCHS * REF_STEPS
+    step_s = round_s / total_steps
+    flops = train_step_flops(config, BATCH)
+    util = mfu(step_s, flops, device)
+    hidden = (stage_s + round_s - overlap_s) / stage_s if stage_s > 0 else None
+    return {
+        "img_size": img,
+        "dtype": dtype,
+        "epochs": REF_EPOCHS,
+        "steps_per_epoch": REF_STEPS,
+        "batch": BATCH,
+        "total_steps": total_steps,
+        "staging_bytes": int(images.nbytes + masks.nbytes),
+        "round_s_raw": round_s,
+        "staging_s_raw": stage_s,
+        "staging_ms": round(stage_s * 1e3, 2),
+        "round_ms": round(round_s * 1e3, 2),
+        "per_step_ms": round(step_s * 1e3, 3),
+        "round_plus_restage_ms": round(overlap_s * 1e3, 2),
+        "staging_hidden_frac": None if hidden is None else round(max(0.0, min(1.0, hidden)), 3),
+        "mfu": None if util is None else round(util, 4),
+    }
 
 
 def main() -> None:
@@ -256,6 +384,20 @@ def main() -> None:
     mesh_f32_compute_s = STEPS * _step_s(sweep[f32_key])
     mesh_bf16_compute_s = STEPS * _step_s(sweep[bf16_key])
 
+    # ---- reference-scale rounds (the reference's real workload) ----
+    reference_scale = {}
+    run_ref = REF_SCALE == "1" or (
+        REF_SCALE == "auto" and getattr(device, "platform", "") == "tpu"
+    )
+    if run_ref:
+        points = [(SIZES[0], "float32"), (SIZES[0], "bfloat16")]
+        if len(SIZES) > 1:
+            points.append((SIZES[1], "bfloat16"))
+        for img, dtype in points:
+            reference_scale[f"{dtype}_{img}"] = _bench_reference_scale(
+                img, dtype, device
+            )
+
     # ---- host plane (reference architecture) at the reference's shape ----
     host_total_s, host_parts = _measure_host_plane(
         n_clients, f32_state0.variables, flagship_per_client, f32_state0
@@ -307,19 +449,67 @@ def main() -> None:
         "batch": BATCH,
     }
 
+    # Headline at the small sweep scale (CPU smoke / ref-scale disabled).
+    metric = (
+        f"flagship one-program FedAvg round wall-clock "
+        f"({n_clients} client(s), {SIZES[0]}x{SIZES[0]}, bf16 compute, "
+        f"b{BATCH}, {STEPS} steps); vs_baseline = host/gRPC-style plane "
+        f"over mesh plane at equal float32 dtype, tunnel-inclusive "
+        f"(see detail for compute-only ratio, MFU sweep, decomposition)"
+    )
+    value = sweep[bf16_key]["round_ms"]
+    vs_baseline = round(host_total_s / mesh_f32_s, 3)
+
+    if reference_scale:
+        # Headline restated AT THE REFERENCE'S SCALE (round-2 verdict #1):
+        # 10 epochs x ~388 steps per round. The host plane at that scale is
+        # reconstructed from measured components — per-step compute slope,
+        # per-step dispatch overhead from the measured 32-step host round,
+        # serialization, host FedAvg — because driving 3,880 Python-dispatched
+        # steps through the tunnel per rep is minutes per measurement for no
+        # added information. Both the tunnel-inclusive ratio and the
+        # dispatch-free compute-only floor are reported.
+        total_steps = REF_EPOCHS * REF_STEPS
+        per_step_overhead_s = dispatch_s / max(1, n_clients * STEPS)
+        ref_f32 = reference_scale[f"float32_{SIZES[0]}"]
+        ref_bf16 = reference_scale[f"bfloat16_{SIZES[0]}"]
+        # 1-client serialization shape: 1 broadcast + 1 upload serialized,
+        # 1 client parse + 1 server parse (NOT this run's n_clients total).
+        ser_ref_s = 2 * host_parts["to_bytes_s_raw"] + 2 * host_parts["from_bytes_s_raw"]
+        agg_ref_s = host_parts["fedavg_s_raw"]
+        host_ref_s = (
+            total_steps * (_step_s(sweep[f32_key]) + per_step_overhead_s)
+            + ser_ref_s
+            + agg_ref_s
+        )
+        host_ref_compute_s = (
+            total_steps * _step_s(sweep[f32_key]) + ser_ref_s + agg_ref_s
+        )
+        detail["reference_scale"] = reference_scale
+        detail["host_ref_reconstructed_s"] = round(host_ref_s, 3)
+        detail["vs_baseline_ref_compute_only"] = round(
+            host_ref_compute_s / ref_f32["round_s_raw"], 3
+        )
+        metric = (
+            f"reference-scale one-program FedAvg round wall-clock "
+            f"(1 client, {SIZES[0]}x{SIZES[0]}, bf16 compute, b{BATCH}, "
+            f"{REF_EPOCHS} epochs x {REF_STEPS} steps = {total_steps} steps, "
+            f"uint8 staging); vs_baseline = reconstructed host/gRPC-style "
+            f"plane over measured mesh round at equal float32 dtype, "
+            f"tunnel-inclusive (detail.vs_baseline_ref_compute_only is the "
+            f"dispatch-free floor; detail.reference_scale has the "
+            f"staging/compute/overlap decomposition)"
+        )
+        value = ref_bf16["round_ms"]
+        vs_baseline = round(host_ref_s / ref_f32["round_s_raw"], 3)
+
     print(
         json.dumps(
             {
-                "metric": (
-                    f"flagship one-program FedAvg round wall-clock "
-                    f"({n_clients} client(s), {SIZES[0]}x{SIZES[0]}, bf16 compute, "
-                    f"b{BATCH}, {STEPS} steps); vs_baseline = host/gRPC-style plane "
-                    f"over mesh plane at equal float32 dtype, tunnel-inclusive "
-                    f"(see detail for compute-only ratio, MFU sweep, decomposition)"
-                ),
-                "value": sweep[bf16_key]["round_ms"],
+                "metric": metric,
+                "value": value,
                 "unit": "ms",
-                "vs_baseline": round(host_total_s / mesh_f32_s, 3),
+                "vs_baseline": vs_baseline,
                 "detail": detail,
             }
         )

@@ -24,6 +24,7 @@ full-resolution masks (SURVEY.md §2.3).
 
 from __future__ import annotations
 
+import os
 from typing import Any
 
 import flax.linen as nn
@@ -31,6 +32,13 @@ import jax
 import jax.numpy as jnp
 
 from fedcrack_tpu.configs import ModelConfig
+from fedcrack_tpu.ops.pooling import max_pool_auto
+
+# A/B escape hatch: FEDCRACK_POOL=default routes the encoder pool through
+# flax's nn.max_pool (XLA SelectAndScatter backward) instead of the
+# grid-size-aware custom VJP — for benchmarking the two lowerings against
+# each other on real hardware. Values are identical either way.
+_USE_CUSTOM_POOL = os.environ.get("FEDCRACK_POOL", "custom") != "default"
 
 # Keras BatchNormalization defaults (the reference relies on them).
 _BN_MOMENTUM = 0.99
@@ -140,7 +148,13 @@ class ResUNet(nn.Module):
             x = nn.relu(x)
             x = SeparableConv(features, dtype=dtype, param_dtype=pdtype, name=f"enc{i}_sep2")(x)
             x = bn(f"enc{i}_bn2")(x)
-            x = nn.max_pool(x, window_shape=(3, 3), strides=(2, 2), padding="SAME")
+            # Same values as nn.max_pool(3x3, s2, SAME); on grids where it
+            # measures faster the backward avoids XLA's SelectAndScatter
+            # (ops/pooling.py — measured crossover at 64x64 on v5e).
+            if _USE_CUSTOM_POOL:
+                x = max_pool_auto(x)
+            else:
+                x = nn.max_pool(x, window_shape=(3, 3), strides=(2, 2), padding="SAME")
             residual = nn.Conv(
                 features, (1, 1), strides=(2, 2), name=f"enc{i}_res", **conv_kw
             )(previous)

@@ -4,3 +4,4 @@ from fedcrack_tpu.ops.losses import (  # noqa: F401
     binary_iou,
     segmentation_metrics,
 )
+from fedcrack_tpu.ops.pooling import max_pool_3x3_s2  # noqa: F401

@@ -372,7 +372,12 @@ class TestSpatialFederatedRound:
             weights.append(n_samples[c])
         want = fedavg(trained, weights)
 
-        _assert_trees_match(got, want, atol=5e-5)
+        # 1e-4: the host path takes the scatter-free pool backward
+        # (ops/pooling.py) while the spatial path pools through its halo
+        # reduce_window with XLA's default gradient — same routing, different
+        # summation order, so the per-step ulp noise compounds slightly more
+        # than the pre-custom-pool 5e-5 calibration allowed.
+        _assert_trees_match(got, want, atol=1e-4)
         assert np.all(np.isfinite(np.asarray(metrics["loss"])))
 
     def test_rejects_misaligned_height(self):

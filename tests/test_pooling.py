@@ -1,0 +1,120 @@
+"""The scatter-free max-pool VJP: forward bit-parity with nn.max_pool,
+gradient parity with XLA's SelectAndScatter lowering (including ties)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedcrack_tpu.ops.pooling import max_pool_3x3_s2
+
+
+def _ref_pool(x):
+    return nn.max_pool(x, window_shape=(3, 3), strides=(2, 2), padding="SAME")
+
+
+@pytest.mark.parametrize("shape", [(2, 16, 16, 4), (1, 15, 17, 3), (3, 7, 7, 1)])
+def test_forward_bit_identical(shape):
+    x = jax.random.normal(jax.random.key(0), shape, jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(max_pool_3x3_s2(x)), np.asarray(_ref_pool(x))
+    )
+
+
+@pytest.mark.parametrize("shape", [(2, 16, 16, 4), (1, 15, 17, 3), (3, 7, 7, 1)])
+def test_gradient_matches_select_and_scatter(shape):
+    """Both lowerings must route each output's cotangent to the same argmax.
+    Integer-valued cotangents make the per-input sums exact in float32, so
+    equality proves identical ROUTING — a float cotangent would add
+    reassociation noise where one input feeds several windows."""
+    x = jax.random.normal(jax.random.key(1), shape, jnp.float32)
+    g = jnp.asarray(
+        np.random.default_rng(2).integers(-8, 9, _ref_pool(x).shape), jnp.float32
+    )
+
+    def loss(pool):
+        return lambda v: jnp.sum(pool(v) * g)
+
+    got = jax.grad(loss(max_pool_3x3_s2))(x)
+    want = jax.grad(loss(_ref_pool))(x)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    # float cotangents: identical up to summation order (1-2 ulp)
+    gf = jax.random.normal(jax.random.key(2), _ref_pool(x).shape, jnp.float32)
+    got_f = jax.grad(lambda v: jnp.sum(max_pool_3x3_s2(v) * gf))(x)
+    want_f = jax.grad(lambda v: jnp.sum(_ref_pool(v) * gf))(x)
+    np.testing.assert_allclose(np.asarray(got_f), np.asarray(want_f), rtol=1e-6, atol=1e-6)
+
+
+def test_gradient_ties_match_xla_tiebreak():
+    """Tied window maxima: SelectAndScatter routes to the first match in
+    row-major window order — the custom backward's claim order matches, so
+    even degenerate (constant) inputs agree exactly."""
+    for x in [
+        jnp.zeros((1, 8, 8, 2), jnp.float32),
+        jnp.ones((2, 9, 6, 3), jnp.float32),
+        jnp.asarray(
+            np.random.default_rng(7).integers(0, 3, (2, 12, 12, 2)), jnp.float32
+        ),  # heavy ties from a 3-value alphabet
+    ]:
+        g = jnp.arange(np.prod(_ref_pool(x).shape), dtype=jnp.float32).reshape(
+            _ref_pool(x).shape
+        )
+        got = jax.grad(lambda v: jnp.sum(max_pool_3x3_s2(v) * g))(x)
+        want = jax.grad(lambda v: jnp.sum(_ref_pool(v) * g))(x)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_nan_window_still_routes_gradient():
+    """A NaN activation must not silently zero the pool gradient: the claim
+    mask uses ~(cand < out), so a NaN window max still claims one offset
+    and the cotangent flows (divergence stays visible upstream)."""
+    x = jax.random.normal(jax.random.key(6), (1, 8, 8, 1), jnp.float32)
+    x = x.at[2, 2].set(jnp.nan) if x.ndim == 2 else x.at[0, 2, 2, 0].set(jnp.nan)
+    g = jax.grad(lambda v: jnp.sum(max_pool_3x3_s2(v)))(x)
+    assert bool(jnp.isnan(x).any())
+    # the NaN pixel sits in several windows; its cotangent must be nonzero
+    assert float(jnp.abs(g[0, 2, 2, 0])) > 0.0
+
+
+def test_gradient_mass_conserved():
+    """Every output routes its cotangent to exactly one input."""
+    x = jax.random.normal(jax.random.key(3), (2, 16, 16, 4), jnp.float32)
+    ones = jnp.ones(_ref_pool(x).shape, jnp.float32)
+    got = jax.grad(lambda v: jnp.sum(max_pool_3x3_s2(v) * ones))(x)
+    assert float(jnp.sum(got)) == pytest.approx(float(ones.size))
+
+
+def test_bfloat16_and_jit_scan():
+    """The training loop runs the op in bf16 under jit+scan."""
+    x = jax.random.normal(jax.random.key(4), (2, 16, 16, 4)).astype(jnp.bfloat16)
+    out = jax.jit(max_pool_3x3_s2)(x)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(out, np.float32), np.asarray(_ref_pool(x), np.float32)
+    )
+
+    def step(carry, _):
+        gr = jax.grad(lambda v: jnp.sum(max_pool_3x3_s2(v)))(carry)
+        return carry + gr.astype(carry.dtype), None
+
+    final, _ = jax.jit(lambda v: jax.lax.scan(step, v, None, length=3))(x)
+    assert final.shape == x.shape
+
+
+def test_model_forward_unchanged_by_custom_pool():
+    """The U-Net's forward (pinned by h5-parity elsewhere) is bit-identical
+    with the custom pool, because the forward IS the same reduce_window."""
+    from fedcrack_tpu.configs import ModelConfig
+    from fedcrack_tpu.models.resunet import ResUNet
+
+    cfg = ModelConfig(
+        img_size=32, stem_features=4, encoder_features=(8,), decoder_features=(8, 4)
+    )
+    model = ResUNet(config=cfg)
+    x = jax.random.normal(jax.random.key(5), (2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 32, 32, 1)
+    assert bool(jnp.all(jnp.isfinite(logits)))
