@@ -14,12 +14,15 @@ import os
 import numpy as np
 
 
-def _crack_polyline(rng: np.random.Generator, size: int) -> np.ndarray:
+def _crack_polyline(
+    rng: np.random.Generator, size: int, min_thickness: int | None = None
+) -> np.ndarray:
     """Boolean crack footprint: a jittered random walk across the tile."""
     mask = np.zeros((size, size), dtype=bool)
     # start on a random edge, walk to the opposite side
     y = rng.integers(0, size)
-    thickness = int(rng.integers(1, max(2, size // 24)))
+    lo_t = 1 if min_thickness is None else min_thickness
+    thickness = int(rng.integers(lo_t, max(lo_t + 1, size // 24)))
     for x in range(size):
         y = int(np.clip(y + rng.integers(-2, 3), 0, size - 1))
         lo = max(0, y - thickness)
@@ -35,12 +38,20 @@ def synth_crack_batch(
     img_size: int = 128,
     seed: int = 0,
     crack_prob: float = 0.8,
+    min_thickness: int | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Generate ``n`` (image, mask) pairs.
 
     Returns ``images`` float32 [n, s, s, 3] in [0, 1] and ``masks`` float32
     [n, s, s, 1] in {0, 1} — the exact tensor contract of the reference's
     ``Generator`` (client_fit_model.py:30-43: RGB /255; mask binarized >0).
+
+    ``min_thickness`` widens the crack stroke (default: hairline, 1 px
+    half-width). IoU on hairline structures is boundary-dominated — at
+    64 px the measured quality CEILING of a 40-epoch fit is ~0.38
+    (bench_runs/r03_quality_posweight_64px.json) — so quality GATES use a
+    thicker stroke where "IoU >= 0.5" separates real localization from
+    luck, while parity fixtures keep the default geometry.
     """
     rng = np.random.default_rng(seed)
     images = np.empty((n, img_size, img_size, 3), np.float32)
@@ -50,7 +61,7 @@ def synth_crack_batch(
         texture = rng.normal(base, 0.06, size=(img_size, img_size, 1)).astype(np.float32)
         img = np.clip(np.repeat(texture, 3, axis=-1), 0.0, 1.0)
         if rng.random() < crack_prob:
-            crack = _crack_polyline(rng, img_size)
+            crack = _crack_polyline(rng, img_size, min_thickness)
             darkness = rng.uniform(0.15, 0.35)
             img[crack] = darkness + rng.normal(0, 0.02, size=(int(crack.sum()), 3)).astype(
                 np.float32

@@ -101,7 +101,18 @@ def _bwd(res, g):
             # NaN max still claims an offset — an equality mask would match
             # nothing (NaN != NaN) and silently ZERO the gradient where the
             # default lowering propagates it, hiding mid-training divergence.
-            m = ~(cand < out) & ~claimed
+            # The validity mask bars SAME-pad candidates from claiming: for
+            # finite values they are -inf and lose anyway, but under a NaN
+            # max every comparison is False and a pad cell at the window's
+            # first offset would swallow the cotangent (the slice at the end
+            # discards pad positions).
+            ys = jnp.arange(ho) * _STRIDE + dy
+            xs = jnp.arange(wo) * _STRIDE + dx
+            valid = (
+                ((ys >= lo_h) & (ys < lo_h + h))[:, None]
+                & ((xs >= lo_w) & (xs < lo_w + w))[None, :]
+            )
+            m = ~(cand < out) & ~claimed & valid[None, :, :, None]
             claimed = claimed | m
             contrib = jnp.where(m, g, zero)
             # Padded-input row hit by window row i at this offset: 2i + dy.

@@ -131,8 +131,7 @@ def measure_c2(args, preset="c2_two_client_grpc", partition="iid", mu=None) -> d
     """c2/c4: K-client FedAvg over real localhost gRPC, end to end."""
     import threading
 
-    from fedcrack_tpu.configs import DataConfig
-    from fedcrack_tpu.data.pipeline import ArrayDataset, dataset_from_source
+    from fedcrack_tpu.data.pipeline import ArrayDataset
     from fedcrack_tpu.data.synthetic import synth_crack_batch
     from fedcrack_tpu.fed.serialization import tree_from_bytes
     from fedcrack_tpu.train.federated import make_train_fn
@@ -201,6 +200,12 @@ def measure_c2(args, preset="c2_two_client_grpc", partition="iid", mu=None) -> d
         eval_hist = list(server.eval_history)
     total_s = _now() - t0
 
+    # A crashed client thread would leave its key out of `results` and a
+    # values()-only check would pass vacuously — the artifact must never
+    # describe a degraded run as the full cohort.
+    assert len(results) == n_clients, (
+        f"only {sorted(results)} of {n_clients} clients completed"
+    )
     assert all(r.enrolled for r in results.values())
     steps_per_round = n_clients * args.epochs * (args.samples // 8)
     round_wall = [h["wall_clock_s"] for h in history]

@@ -68,14 +68,24 @@ def test_gradient_ties_match_xla_tiebreak():
 
 def test_nan_window_still_routes_gradient():
     """A NaN activation must not silently zero the pool gradient: the claim
-    mask uses ~(cand < out), so a NaN window max still claims one offset
-    and the cotangent flows (divergence stays visible upstream)."""
+    mask uses ~(cand < out) with SAME-pad candidates barred, so a NaN
+    window max still claims one REAL offset and the cotangent flows
+    (divergence stays visible upstream)."""
+    # interior NaN, even size (no pad ambiguity)
     x = jax.random.normal(jax.random.key(6), (1, 8, 8, 1), jnp.float32)
-    x = x.at[2, 2].set(jnp.nan) if x.ndim == 2 else x.at[0, 2, 2, 0].set(jnp.nan)
+    x = x.at[0, 2, 2, 0].set(jnp.nan)
     g = jax.grad(lambda v: jnp.sum(max_pool_3x3_s2(v)))(x)
-    assert bool(jnp.isnan(x).any())
-    # the NaN pixel sits in several windows; its cotangent must be nonzero
     assert float(jnp.abs(g[0, 2, 2, 0])) > 0.0
+
+    # corner NaN on an ODD size: pad_lo = 1, so the corner window's first
+    # row-major candidate is a pad cell — without the validity mask the
+    # pad claims the cotangent and the slice discards it (gradient mass
+    # silently lost; reproduced before the fix: total 24.0 vs 25.0).
+    x = jnp.zeros((1, 9, 9, 1), jnp.float32).at[0, 0, 0, 0].set(jnp.nan)
+    g = jax.grad(lambda v: jnp.sum(max_pool_3x3_s2(v)))(x)
+    assert float(jnp.abs(g[0, 0, 0, 0])) > 0.0
+    out_size = max_pool_3x3_s2(jnp.zeros((1, 9, 9, 1))).size
+    assert float(jnp.sum(g)) == pytest.approx(float(out_size))
 
 
 def test_gradient_mass_conserved():
