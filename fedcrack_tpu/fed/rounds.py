@@ -275,9 +275,13 @@ def _advance_time(state: ServerState, now: float) -> ServerState:
             # at the same round — round counter and global weights survive
             # (fix #5; previously this stalled in PHASE_RUNNING forever,
             # the same liveness class as the reference's barrier hang).
+            # The dead members go to `departed` so one that restarts AFTER
+            # a fresh cohort closed enrollment can still re-admit itself
+            # (fix #6 — otherwise it would be CTW-locked out).
             state = state._replace(
                 phase=PHASE_ENROLL,
                 cohort=frozenset(),
+                departed=state.departed | state.cohort,
                 enroll_opened_at=None,
                 round_started_at=None,
                 failed_rounds=state.failed_rounds + 1,

@@ -210,6 +210,12 @@ def measure_c2(args, preset="c2_two_client_grpc", partition="iid", mu=None) -> d
     steps_per_round = n_clients * args.epochs * (args.samples // 8)
     round_wall = [h["wall_clock_s"] for h in history]
     last_eval = eval_hist[-1] if eval_hist else {}
+
+    def _q(key):
+        # None (-> JSON null) when the off-loop eval missed the deadline;
+        # float('nan') would serialize as bare NaN and break strict parsers.
+        v = last_eval.get(key)
+        return None if v is None else round(float(v), 4)
     return {
         "config": preset if mu is None else "c4_noniid_fedprox",
         "hardware": _hardware(),
@@ -226,9 +232,9 @@ def measure_c2(args, preset="c2_two_client_grpc", partition="iid", mu=None) -> d
             "received_per_round": int(np.median([h["bytes_received"] for h in history])),
             "broadcast_per_round": int(np.median([h["bytes_broadcast"] for h in history])),
         },
-        "val_loss": round(float(last_eval.get("loss", float("nan"))), 4),
-        "pixel_acc": round(float(last_eval.get("pixel_acc", float("nan"))), 4),
-        "iou": round(float(last_eval.get("iou", float("nan"))), 4),
+        "val_loss": _q("loss"),
+        "pixel_acc": _q("pixel_acc"),
+        "iou": _q("iou"),
         "notes": "real localhost gRPC, real trainers; round wall-clock from "
                  "the coordinator's round history; quality = server-side "
                  "eval of the final aggregated model on held-out fixtures",
@@ -276,9 +282,36 @@ def measure_mesh(args, preset: str, n_clients: int, n_batch: int) -> dict:
     # first round includes compilation; report the post-compile median
     round_s = float(np.median(times[1:])) if len(times) > 1 else times[0]
     steps_per_round = args.epochs * args.mesh_steps
+
+    # Quality at a workload a 1-core CPU host can actually train: the
+    # HOST-plane federation of the same config — bit-equal aggregation to
+    # the mesh program (pinned by
+    # tests/test_parallel.py::test_mesh_round_equals_host_round), without
+    # 8 virtual device threads spin-waiting on collectives over one core.
+    from fedcrack_tpu.data.pipeline import ArrayDataset
+    from fedcrack_tpu.fed.algorithms import fedavg
+    from fedcrack_tpu.train.local import local_fit
+
+    q_samples, q_epochs, q_rounds = args.samples, args.epochs_quality, args.rounds
+    q_data = [
+        synth_crack_batch(q_samples, img, seed=20 + i) for i in range(n_clients)
+    ]
+    vars_q = state0.variables
+    for _ in range(q_rounds):
+        trained = []
+        for ci in range(n_clients):
+            st = create_train_state(
+                jax.random.key(cfg.seed), model_cfg, cfg.learning_rate
+            ).replace_variables(vars_q)
+            ds = ArrayDataset(q_data[ci][0], q_data[ci][1], batch_size=batch, seed=ci)
+            st, _ = local_fit(
+                st, ds, epochs=q_epochs, pos_weight=args.pos_weight,
+                mu=cfg.fedprox_mu, anchor_params=vars_q["params"],
+            )
+            trained.append(jax.device_get(st.variables))
+        vars_q = fedavg(trained, weights=[float(q_samples)] * n_clients)
     quality = _eval_quality(
-        jax.device_get(variables), model_cfg, n_val=32, seed=999,
-        pos_weight=args.pos_weight,
+        vars_q, model_cfg, n_val=32, seed=999, pos_weight=args.pos_weight
     )
     return {
         "config": preset,
@@ -289,21 +322,55 @@ def measure_mesh(args, preset: str, n_clients: int, n_batch: int) -> dict:
             "local_epochs": args.epochs, "steps_per_epoch": args.mesh_steps,
             "compute_dtype": model_cfg.compute_dtype,
             "pos_weight": args.pos_weight,
+            "quality_workload": {
+                "samples_per_client": q_samples, "local_epochs": q_epochs,
+                "rounds": q_rounds, "path": "host-plane equivalent",
+            },
         },
         "round_wall_clock_s": round(round_s, 3),
         "compile_round_s": round(times[0], 2),
         "per_step_ms": round(round_s / steps_per_round * 1e3, 2),
         "data_plane_bytes_staged": int(images.nbytes + masks.nbytes),
         **quality,
-        "notes": "one-program mesh round (psum FedAvg on the clients axis); "
-                 "quality = held-out eval of the final-round aggregate with "
-                 "BN recalibration; timing is wherever this ran — see "
-                 "hardware.platform (real-chip single-chip slopes live in "
-                 "the BENCH artifact)",
+        "notes": "one-program mesh round (psum FedAvg on the clients axis) "
+                 "executed for timing/correctness; quality = held-out eval "
+                 "of the HOST-plane federation of the same config (bit-equal "
+                 "aggregation per the mesh-vs-host golden test) at the "
+                 "quality_workload — virtual-device collectives spin-wait on "
+                 "a 1-core host, so training a quality-bearing workload "
+                 "through the mesh program there is infeasible; timing is "
+                 "wherever this ran (hardware.platform; real-chip slopes "
+                 "live in the BENCH artifact)",
     }
 
 
+def _apply_platform_env() -> None:
+    """This image pre-imports jax on the axon (TPU tunnel) platform at
+    interpreter startup, swallowing JAX_PLATFORMS/XLA_FLAGS env overrides —
+    re-apply them through the runtime config API while the backends are
+    still uninitialized (same hook as bench.py / __graft_entry__)."""
+    import re
+
+    flag = re.search(
+        r"xla_force_host_platform_device_count=(\d+)",
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    plats = [
+        p.strip()
+        for p in os.environ.get("JAX_PLATFORMS", "").lower().split(",")
+        if p.strip()
+    ]
+    if (plats and plats[0] == "cpu") or (flag and not plats):
+        try:
+            if flag:
+                jax.config.update("jax_num_cpu_devices", int(flag.group(1)))
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass  # backends already initialized; measure where we are
+
+
 def main(argv=None) -> int:
+    _apply_platform_env()
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--out", required=True)
     p.add_argument("--configs", default="c1,c2,c3,c4,c5")
@@ -315,6 +382,10 @@ def main(argv=None) -> int:
     p.add_argument("--mesh-img", type=int, default=None,
                    help="override mesh rows' crop (CPU hosts may want 128)")
     p.add_argument("--pos-weight", type=float, default=5.0)
+    p.add_argument(
+        "--epochs-quality", type=int, default=2, dest="epochs_quality",
+        help="local epochs for the mesh rows' host-plane quality federation",
+    )
     args = p.parse_args(argv)
 
     want = set(args.configs.split(","))

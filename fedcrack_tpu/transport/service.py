@@ -358,6 +358,16 @@ class FedServer:
             (grpc.method_handlers_generic_handler(SERVICE_NAME, {METHOD: handler}),)
         )
         address = f"{self.config.host}:{self.config.port}"
+        if self.config.tls_ca and not (self.config.tls_cert and self.config.tls_key):
+            # tls_ca alone is a CLIENT configuration (root to verify the
+            # server). A server launched with it but no cert/key would
+            # silently bind plaintext while the operator believes mTLS is
+            # on — the exact failure mode the cert/key pairing check
+            # prevents.
+            raise ValueError(
+                "server has tls_ca but no tls_cert/tls_key: client-cert "
+                "enforcement (mTLS) requires the server's own TLS identity"
+            )
         if self.config.tls_cert and self.config.tls_key:
             # TLS server credentials (the reference served an insecure port
             # only, fl_server.py:218). With tls_ca set too, client certs

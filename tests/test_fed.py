@@ -257,6 +257,22 @@ def test_silent_cohort_deadline_reopens_enrollment():
     assert state.current_round == 2
 
 
+def test_silent_cohort_member_can_rejoin_fresh_cohort():
+    """A member of a cohort that died wholesale (fix #5 reopen) must be able
+    to rejoin even after a FRESH cohort closed enrollment — the dead members
+    land in `departed`, so their restart re-admits instead of CTW."""
+    cfg = dataclasses.replace(CFG, round_deadline_s=5.0, cohort_size=1)
+    state = R.initial_state(cfg, _tree(42))
+    state, _ = R.transition(state, R.Ready("a", now=0.0))   # cohort {a}, RUNNING
+    state, _ = R.transition(state, R.Tick(now=100.0))       # a died -> reopen
+    assert state.phase == R.PHASE_ENROLL and "a" in state.departed
+    state, _ = R.transition(state, R.Ready("c", now=101.0))  # fresh cohort closes
+    assert state.phase == R.PHASE_RUNNING
+    state, r = R.transition(state, R.Ready("a", now=102.0))  # a restarts
+    assert r.status == R.SW
+    assert state.cohort == frozenset({"a", "c"})
+
+
 def test_cohort_member_rejoins_after_crash():
     """Fix #6 regression: Ready from an enrolled cname during RUNNING
     re-syncs the client (SW + current round) instead of locking it out."""

@@ -280,6 +280,15 @@ def test_partial_tls_config_fails_fast():
         FedConfig(tls_key="/some/key.pem")
 
 
+def test_server_with_ca_only_refuses_to_bind_plaintext():
+    """tls_ca alone is a client config; a SERVER launched with it must not
+    silently bind a plaintext port while the operator believes mTLS is on."""
+    cfg = FedConfig(port=0, tls_ca="/some/ca.pem")
+    server = FedServer(cfg, _vars(0.0))
+    with pytest.raises(ValueError, match="mTLS"):
+        server._build()
+
+
 def _self_signed_cert(tmp_path):
     """A throwaway self-signed cert for 127.0.0.1 (valid as its own CA)."""
     import datetime
