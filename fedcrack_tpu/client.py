@@ -72,6 +72,15 @@ def main(argv: list[str] | None = None) -> int:
         help="shared enrollment token (must match the server's)",
     )
     p.add_argument(
+        "--allow-insecure-token",
+        dest="allow_insecure_token",
+        action="store_const",
+        const=True,
+        default=None,
+        help="accept --auth-token over a plaintext channel (the secret then "
+        "travels in cleartext on every message; loopback/testing only)",
+    )
+    p.add_argument(
         "--tls-ca",
         dest="tls_ca",
         help="root CA (PEM) to verify the server over TLS; plaintext if unset",
@@ -80,11 +89,17 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--tls-key", dest="tls_key", help="client private key for mTLS (PEM)")
     args = p.parse_args(argv)
 
+    # Flags merge into the RAW config dict before FedConfig construction, so
+    # __post_init__ validation sees the final merged config (a --tls-ca or
+    # --allow-insecure-token flag must be able to rescue a config file that
+    # would fail the plaintext-token check on its own).
     if args.config:
+        import json
+
         with open(args.config) as f:
-            cfg = FedConfig.from_json(f.read())
+            raw = json.load(f)
     else:
-        cfg = FedConfig()
+        raw = {}
     overrides = {
         k: v
         for k, v in [
@@ -94,16 +109,15 @@ def main(argv: list[str] | None = None) -> int:
             ("tb_dir", args.tb_dir),
             ("profile_dir", args.profile_dir),
             ("auth_token", args.auth_token),
+            ("allow_insecure_token", args.allow_insecure_token),
             ("tls_ca", args.tls_ca),
             ("tls_cert", args.tls_cert),
             ("tls_key", args.tls_key),
         ]
         if v is not None
     }
-    if overrides:
-        import dataclasses
-
-        cfg = dataclasses.replace(cfg, **overrides)
+    raw.update(overrides)
+    cfg = FedConfig.from_dict(raw)
 
     batch = cfg.data.batch_size
     if args.num_clients is not None:

@@ -148,8 +148,16 @@ class FedConfig:
     # enroll or poison the cohort (fl_client.py:181, SURVEY.md §5.8).
     # auth_token: shared secret required on every client message when set
     # (constant-time compared server-side; unauthenticated messages are
-    # REJECTED). Empty disables.
+    # REJECTED). Empty disables. Over a plaintext channel the token would
+    # travel in cleartext on every message, so auth_token without TLS
+    # (no tls_cert/tls_key on the server, no tls_ca on the client) is
+    # refused unless allow_insecure_token is set explicitly.
     auth_token: str = ""
+    # Escape hatch for loopback/test deployments that genuinely want a
+    # shared token over plaintext. Anything crossing a real network should
+    # configure TLS instead — with this on, anyone on the path reads the
+    # secret off the first message.
+    allow_insecure_token: bool = False
     # TLS: the server serves with ssl_server_credentials when tls_cert +
     # tls_key are both set (PEM file paths); a client connects over TLS
     # when tls_ca is set (PEM root to verify the server). When the server
@@ -183,6 +191,22 @@ class FedConfig:
             raise ValueError(
                 "tls_cert and tls_key must be set together; got "
                 f"tls_cert={self.tls_cert!r}, tls_key={self.tls_key!r}"
+            )
+        if (
+            self.auth_token
+            and not (self.tls_cert or self.tls_ca)
+            and not self.allow_insecure_token
+        ):
+            # A shared secret over a plaintext channel is sent in cleartext
+            # on EVERY message — an operator following a quickstart would
+            # ship it to any on-path observer without noticing. Refuse the
+            # combination unless it is opted into by name.
+            raise ValueError(
+                "auth_token is set but the channel is plaintext (no TLS "
+                "config): the secret would travel in cleartext on every "
+                "message. Configure tls_cert/tls_key (server) or tls_ca "
+                "(client), or set allow_insecure_token=true to accept this "
+                "for loopback/testing."
             )
 
     # ---- serialization (in-band config map + files) ----

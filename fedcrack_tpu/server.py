@@ -108,6 +108,15 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         help="shared enrollment token: every client message must carry it "
         "or is REJECTED (the reference accepted anyone reaching the port)",
     )
+    p.add_argument(
+        "--allow-insecure-token",
+        dest="allow_insecure_token",
+        action="store_const",
+        const=True,
+        default=None,
+        help="accept --auth-token over a plaintext channel (the secret then "
+        "travels in cleartext on every message; loopback/testing only)",
+    )
     p.add_argument("--tls-cert", dest="tls_cert", help="server TLS certificate (PEM)")
     p.add_argument("--tls-key", dest="tls_key", help="server TLS private key (PEM)")
     p.add_argument(
@@ -118,11 +127,16 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
     )
     args = p.parse_args(argv)
 
+    # Flags merge into the RAW config dict before FedConfig construction:
+    # __post_init__ validation (TLS pairing, plaintext-token refusal) must
+    # see the final merged config, or a flag meant to resolve a validation
+    # error (--allow-insecure-token, --tls-*) could never rescue a config
+    # file that fails it.
     if args.config:
         with open(args.config) as f:
-            cfg = FedConfig.from_json(f.read())
+            raw = json.load(f)
     else:
-        cfg = FedConfig()
+        raw = {}
     overrides = {}
     for flag, field in [
         ("rounds", "max_rounds"),
@@ -145,6 +159,7 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         ("init_weights", "init_weights"),
         ("best_path", "best_path"),
         ("auth_token", "auth_token"),
+        ("allow_insecure_token", "allow_insecure_token"),
         ("tls_cert", "tls_cert"),
         ("tls_key", "tls_key"),
         ("tls_ca", "tls_ca"),
@@ -152,10 +167,8 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         val = getattr(args, flag)
         if val is not None:
             overrides[field] = val
-    if overrides:
-        import dataclasses
-
-        cfg = dataclasses.replace(cfg, **overrides)
+    raw.update(overrides)
+    cfg = FedConfig.from_dict(raw)
     shown = json.loads(cfg.to_json())
     if shown.get("auth_token"):
         shown["auth_token"] = "<redacted>"  # the secret must not hit logs

@@ -117,6 +117,17 @@ class FedClient:
             )
             channel = grpc.secure_channel(target, creds, options=options)
         else:
+            if self.config.auth_token and not self.config.allow_insecure_token:
+                # Role-aware re-check at the actual channel build: the config
+                # validation accepts auth_token + tls_cert/tls_key (a valid
+                # SERVER config), but a CLIENT encrypts only via tls_ca — a
+                # client reusing the server's config file would otherwise
+                # pass validation and still ship the secret in cleartext.
+                raise ValueError(
+                    "auth_token over a plaintext client channel: set tls_ca "
+                    "to verify the server over TLS, or allow_insecure_token "
+                    "for loopback/testing"
+                )
             channel = grpc.insecure_channel(target, options=options)
         method = channel.stream_stream(
             f"/{SERVICE_NAME}/{METHOD}",

@@ -293,9 +293,13 @@ class FedServer:
                 # Authentication precedes ALL protocol processing: an
                 # unauthenticated Ready/TrainDone/LogChunk never reaches the
                 # state machine (the reference accepted anything that could
-                # reach the port, fl_client.py:181).
+                # reach the port, fl_client.py:181). The stream terminates
+                # after the rejection: on a kept-open stream every further
+                # message (up to max_message_mb) would be fully received and
+                # parsed before its token check, letting an unauthenticated
+                # peer sustain bandwidth/memory pressure on one RPC.
                 yield pb.ServerMessage(status=R.REJECTED, title="unauthenticated")
-                continue
+                return
             try:
                 # Decode (and CRC-verify log chunks) off the event loop: the
                 # pure-Python CRC fallback costs ~0.3 s/MiB, which inline

@@ -250,7 +250,12 @@ def test_auth_token_gates_every_message(session_cfg):
     already-authenticated flow's uploads are still checked per-message.
     The token is deliberately non-ASCII: the comparison must be over
     utf-8 bytes (str-domain compare_digest raises on non-ASCII)."""
-    cfg = dataclasses.replace(session_cfg, cohort_size=1, auth_token="s3crét-käy")
+    cfg = dataclasses.replace(
+        session_cfg,
+        cohort_size=1,
+        auth_token="s3crét-käy",
+        allow_insecure_token=True,  # loopback test: plaintext token opt-in
+    )
     server = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
     with ServerThread(server) as st:
         ok = FedClient(cfg, _fake_train(1.0, 10), cname="good", port=st.port)
@@ -270,6 +275,58 @@ def test_auth_token_gates_every_message(session_cfg):
         state = st.state
     assert not r_bad.enrolled and not r_noauth.enrolled
     assert state.cohort == frozenset()  # nothing reached the state machine
+
+
+def test_auth_token_over_plaintext_refused_without_optin(session_cfg):
+    """A shared token over a plaintext channel ships the secret in cleartext
+    on every message; the config refuses the combination unless opted into
+    by name (round-3 advisor + VERDICT weak #4)."""
+    with pytest.raises(ValueError, match="plaintext"):
+        dataclasses.replace(session_cfg, auth_token="s3cret")
+    # explicit opt-in or any TLS half resolves it
+    dataclasses.replace(session_cfg, auth_token="s3cret", allow_insecure_token=True)
+    dataclasses.replace(session_cfg, auth_token="s3cret", tls_ca="/some/ca.pem")
+    # Role-aware: a CLIENT holding a server-shaped config (tls_cert/tls_key
+    # but no tls_ca) passes config validation — it is a valid SERVER config —
+    # but only tls_ca encrypts the client channel, so dialing must refuse.
+    srv_shaped = dataclasses.replace(
+        session_cfg, auth_token="s3cret", tls_cert="/c.pem", tls_key="/k.pem"
+    )
+    client = FedClient(srv_shaped, _fake_train(1.0, 10), cname="x", port=1)
+    with pytest.raises(ValueError, match="plaintext client channel"):
+        client._connect()
+
+
+def test_unauthenticated_stream_terminates_after_rejection(session_cfg):
+    """After the first failed token check the server ends the stream: a peer
+    without the token must not keep one RPC open feeding arbitrarily many
+    (up to max_message_mb) messages through receive+parse (round-3 advisor).
+    A well-behaved client is unaffected — it sends one message per call."""
+    import grpc
+
+    from fedcrack_tpu.transport import transport_pb2 as pb
+    from fedcrack_tpu.transport.service import METHOD, SERVICE_NAME
+
+    cfg = dataclasses.replace(
+        session_cfg, auth_token="s3cret", allow_insecure_token=True
+    )
+    server = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
+    with ServerThread(server) as st:
+        channel = grpc.insecure_channel(f"127.0.0.1:{st.port}")
+        method = channel.stream_stream(
+            f"/{SERVICE_NAME}/{METHOD}",
+            request_serializer=pb.ClientMessage.SerializeToString,
+            response_deserializer=pb.ServerMessage.FromString,
+        )
+        bad = pb.ClientMessage(cname="evil", token="wrong")
+        bad.ready.SetInParent()
+        # Two unauthenticated messages on ONE stream: the first is answered
+        # REJECTED, then the stream closes — exactly one reply comes back.
+        replies = list(method(iter([bad, bad]), timeout=10, wait_for_ready=True))
+        assert [r.status for r in replies] == [R.REJECTED]
+        channel.close()
+        state = st.state
+    assert state.cohort == frozenset()
 
 
 def test_partial_tls_config_fails_fast():
@@ -294,6 +351,7 @@ def _self_signed_cert(tmp_path):
     import datetime
     import ipaddress
 
+    pytest.importorskip("cryptography")  # not a package dependency: skip, not error
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
@@ -353,7 +411,9 @@ def test_tls_session_and_plaintext_refused(session_cfg, tmp_path):
 
     server2 = FedServer(server_cfg, _vars(0.0), tick_period_s=0.05)
     with ServerThread(server2) as st:
-        plain_cfg = dataclasses.replace(server_cfg, tls_cert="", tls_key="")
+        plain_cfg = dataclasses.replace(
+            server_cfg, tls_cert="", tls_key="", allow_insecure_token=True
+        )
         plain = FedClient(
             plain_cfg, _fake_train(1.0, 10), cname="plain", port=st.port,
             max_retries=2, call_timeout_s=5.0,
