@@ -20,11 +20,12 @@ from fedcrack_tpu.obs.metrics import (
     read_metrics,
     stopwatch,
 )
-from fedcrack_tpu.obs.tb import SummaryWriter, read_scalars
+from fedcrack_tpu.obs.tb import SummaryWriter, read_histograms, read_scalars
 
 __all__ = [
     "MetricsLogger",
     "SummaryWriter",
+    "read_histograms",
     "device_peak_flops",
     "mfu",
     "profiler_trace",

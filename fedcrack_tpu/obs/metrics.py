@@ -79,6 +79,29 @@ class MetricsLogger:
             self._echo(line)
         return record
 
+    @property
+    def tb_enabled(self) -> bool:
+        """Whether a TensorBoard tee is attached — callers can skip building
+        histogram inputs (e.g. a weight-delta tree) when nothing consumes
+        them."""
+        return self._tb is not None
+
+    def log_histograms(self, step: int, tree: Any, prefix: str = "weights") -> None:
+        """Tee per-layer distributions of a pytree (weights, round updates)
+        into the TensorBoard file as histogram summaries — the reference's
+        histogram_freq=1 Keras callback (client_fit_model.py:153-154).
+        No-op without a tb_dir; the JSONL sink stays scalar-only (a
+        30-bucket histogram per layer per round belongs in TB, not in the
+        structured record of truth)."""
+        if self._tb is None:
+            return
+        import jax
+
+        leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+        for path, leaf in leaves:
+            name = "/".join(_path_part(k) for k in path)
+            self._tb.add_histogram(f"{prefix}/{name}", leaf, step)
+
     def close(self) -> None:
         if self._owns:
             with self._lock:
@@ -91,6 +114,15 @@ class MetricsLogger:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+def _path_part(key: Any) -> str:
+    """One tree-path element as a clean tag component (DictKey('conv') ->
+    'conv', SequenceKey(2) -> '2')."""
+    for attr in ("key", "idx", "name"):
+        if hasattr(key, attr):
+            return str(getattr(key, attr))
+    return str(key)
 
 
 def _coerce(value: Any) -> Any:

@@ -85,6 +85,69 @@ def _scalar_event(tag: str, value: float, step: int, wall_time: float) -> bytes:
     )
 
 
+def _packed_doubles(number: int, values) -> bytes:
+    return _field_bytes(number, b"".join(struct.pack("<d", float(v)) for v in values))
+
+
+def _histo_event(
+    tag: str, histo: "HistoData", step: int, wall_time: float
+) -> bytes:
+    """Event{wall_time, step, summary{value{tag(1), histo(5)}}} where histo is
+    TF's HistogramProto: min(1:double), max(2), num(3), sum(4),
+    sum_squares(5), bucket_limit(6: packed double), bucket(7: packed double)
+    — the wire shape Keras' histogram_freq=1 callback writes
+    (reference: client_fit_model.py:153-154)."""
+    proto = (
+        _field_double(1, histo.min)
+        + _field_double(2, histo.max)
+        + _field_double(3, histo.num)
+        + _field_double(4, histo.sum)
+        + _field_double(5, histo.sum_squares)
+        + _packed_doubles(6, histo.bucket_limit)
+        + _packed_doubles(7, histo.bucket)
+    )
+    summary_value = _field_bytes(1, tag.encode("utf-8")) + _field_bytes(5, proto)
+    summary = _field_bytes(1, summary_value)
+    return (
+        _field_double(1, wall_time)
+        + _field_varint(2, int(step))
+        + _field_bytes(5, summary)
+    )
+
+
+class HistoData:
+    """Bucketized distribution in TF HistogramProto shape. ``bucket[i]``
+    counts values in ``(bucket_limit[i-1], bucket_limit[i]]``; the arrays are
+    equal-length, as TensorBoard's event_accumulator requires."""
+
+    __slots__ = ("min", "max", "num", "sum", "sum_squares", "bucket_limit", "bucket")
+
+    def __init__(self, values, bins: int = 30):
+        import numpy as np
+
+        flat = np.asarray(values, dtype=np.float64).reshape(-1)
+        flat = flat[np.isfinite(flat)]
+        self.num = float(flat.size)
+        if flat.size == 0:
+            self.min = self.max = self.sum = self.sum_squares = 0.0
+            self.bucket_limit = [0.0]
+            self.bucket = [0.0]
+            return
+        self.min = float(flat.min())
+        self.max = float(flat.max())
+        self.sum = float(flat.sum())
+        self.sum_squares = float(np.square(flat).sum())
+        if self.min == self.max:
+            # Degenerate distribution: one bucket holding everything, its
+            # upper edge nudged so the (lo, hi] interval is non-empty.
+            self.bucket_limit = [self.max + max(1e-12, abs(self.max) * 1e-7)]
+            self.bucket = [self.num]
+            return
+        counts, edges = np.histogram(flat, bins=bins, range=(self.min, self.max))
+        self.bucket_limit = [float(e) for e in edges[1:]]
+        self.bucket = [float(c) for c in counts]
+
+
 def _version_event(wall_time: float) -> bytes:
     return _field_double(1, wall_time) + _field_bytes(3, b"brain.Event:2")
 
@@ -129,6 +192,26 @@ class SummaryWriter:
             )
         )
 
+    def add_histogram(
+        self,
+        tag: str,
+        values,
+        step: int,
+        wall_time: float | None = None,
+        bins: int = 30,
+    ) -> None:
+        """Log the distribution of ``values`` (any array-like; flattened,
+        non-finite entries dropped) — the reference's per-epoch weight
+        histograms (histogram_freq=1, client_fit_model.py:153-154)."""
+        self._write(
+            _histo_event(
+                tag,
+                HistoData(values, bins=bins),
+                step,
+                time.time() if wall_time is None else wall_time,
+            )
+        )
+
     def close(self) -> None:
         with self._lock:
             if not self._f.closed:
@@ -146,6 +229,72 @@ def read_scalars(path: str | os.PathLike) -> list[tuple[str, float, int]]:
     self-contained round-trip oracle (tests also cross-check with the real
     TensorBoard event_accumulator). Verifies record CRCs."""
     out = []
+    for step, value in _summary_values(path):
+        tag, val = "", None
+        for number, wire, payload in _parse_fields(value):
+            if number == 1 and wire == 2:
+                tag = payload.decode("utf-8")
+            elif number == 2 and wire == 5:  # simple_value
+                (val,) = struct.unpack("<f", payload)
+        if val is not None:
+            out.append((tag, val, step))
+    return out
+
+
+def read_histograms(path: str | os.PathLike) -> list[tuple[str, dict, int]]:
+    """Histogram counterpart of :func:`read_scalars`:
+    ``[(tag, {min,max,num,sum,sum_squares,bucket_limit,bucket}, step), ...]``.
+    Verifies record CRCs like the scalar reader."""
+    out = []
+    for step, value in _summary_values(path):
+        tag, histo = "", None
+        for number, wire, payload in _parse_fields(value):
+            if number == 1 and wire == 2:
+                tag = payload.decode("utf-8")
+            elif number == 5 and wire == 2:  # histo
+                histo = _parse_histo(payload)
+        if histo is not None:
+            out.append((tag, histo, step))
+    return out
+
+
+def _parse_histo(buf: bytes) -> dict:
+    names = {1: "min", 2: "max", 3: "num", 4: "sum", 5: "sum_squares"}
+    out = {"min": 0.0, "max": 0.0, "num": 0.0, "sum": 0.0, "sum_squares": 0.0,
+           "bucket_limit": [], "bucket": []}
+    for number, wire, value in _parse_fields(buf):
+        if number in names and wire == 1:
+            (out[names[number]],) = struct.unpack("<d", value)
+        elif number in (6, 7) and wire == 2:  # packed double
+            key = "bucket_limit" if number == 6 else "bucket"
+            out[key] = [
+                struct.unpack_from("<d", value, i)[0]
+                for i in range(0, len(value), 8)
+            ]
+    return out
+
+
+def _summary_values(path: str | os.PathLike):
+    """The one event walker both readers share: yields ``(step, bytes)`` per
+    Summary.Value in file order. The event's step field may be encoded
+    before or after the summary, so values are collected per event and
+    yielded with the event's final step."""
+    for event in _records(path):
+        step = 0
+        values = []
+        for number, wire, value in _parse_fields(event):
+            if number == 2 and wire == 0:
+                step = value
+            elif number == 5 and wire == 2:  # summary
+                for n2, w2, v2 in _parse_fields(value):
+                    if n2 == 1 and w2 == 2:  # Summary.Value
+                        values.append(v2)
+        for v in values:
+            yield step, v
+
+
+def _records(path: str | os.PathLike):
+    """CRC-verified TFRecord payloads of an event file."""
     with open(os.fspath(path), "rb") as f:
         data = f.read()
     pos = 0
@@ -160,8 +309,7 @@ def read_scalars(path: str | os.PathLike) -> list[tuple[str, float, int]]:
         if _masked_crc(event) != data_crc:
             raise ValueError(f"corrupt event CRC at byte {pos}")
         pos += 12 + length + 4
-        out.extend(_parse_event(event))
-    return out
+        yield event
 
 
 def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
@@ -195,23 +343,3 @@ def _parse_fields(buf: bytes):
         else:
             raise ValueError(f"unsupported wire type {wire}")
         yield number, wire, value
-
-
-def _parse_event(event: bytes) -> list[tuple[str, float, int]]:
-    step = 0
-    scalars = []
-    for number, wire, value in _parse_fields(event):
-        if number == 2 and wire == 0:
-            step = value
-        elif number == 5 and wire == 2:  # summary
-            for n2, w2, v2 in _parse_fields(value):
-                if n2 == 1 and w2 == 2:  # Summary.Value
-                    tag, val = "", None
-                    for n3, w3, v3 in _parse_fields(v2):
-                        if n3 == 1 and w3 == 2:
-                            tag = v3.decode("utf-8")
-                        elif n3 == 2 and w3 == 5:
-                            (val,) = struct.unpack("<f", v3)
-                    if val is not None:
-                        scalars.append((tag, val, step))
-    return [(t, v, step) for t, v, _ in scalars]

@@ -95,6 +95,16 @@ def make_train_fn(
                 bytes_out=len(out_blob),
                 **metrics,
             )
+            if metrics_logger.tb_enabled:
+                # Per-round weight + round-update distributions as TB
+                # histograms (the reference's histogram_freq=1 callback,
+                # client_fit_model.py:153-154); the update tree — trained
+                # minus received params — is the round's pseudo-gradient.
+                metrics_logger.log_histograms(rnd, st.params, prefix="weights")
+                update = jax.tree.map(
+                    lambda a, b: a - b, st.params, variables["params"]
+                )
+                metrics_logger.log_histograms(rnd, update, prefix="round_update")
         return out_blob, n_samples, metrics
 
     return train_fn, holder

@@ -187,3 +187,88 @@ def test_metrics_logger_tees_tb_scalars(tmp_path):
     assert not [t for t, _, _ in got if t.startswith("session/")]
     # the JSONL record of truth is untouched by the tee
     assert len(read_metrics(tmp_path / "m.jsonl", "round")) == 2
+
+
+def test_tb_histogram_roundtrip(tmp_path):
+    """add_histogram -> read_histograms preserves the distribution stats and
+    bucket structure (equal-length limit/count arrays, counts sum to num)."""
+    from fedcrack_tpu.obs import SummaryWriter, read_histograms
+
+    rng = np.random.default_rng(0)
+    values = rng.normal(0.0, 1.0, size=(7, 11)).astype(np.float32)
+    with SummaryWriter(tmp_path) as w:
+        w.add_histogram("weights/conv", values, step=2)
+        w.add_histogram("weights/const", np.full(5, 3.25), step=2)  # degenerate
+        w.add_histogram("weights/empty", np.array([]), step=2)
+        path = w.path
+    got = {tag: (h, step) for tag, h, step in read_histograms(path)}
+
+    h, step = got["weights/conv"]
+    assert step == 2
+    assert h["num"] == values.size
+    np.testing.assert_allclose(h["min"], values.min(), rtol=1e-6)
+    np.testing.assert_allclose(h["max"], values.max(), rtol=1e-6)
+    np.testing.assert_allclose(h["sum"], float(values.astype(np.float64).sum()), rtol=1e-6)
+    np.testing.assert_allclose(
+        h["sum_squares"], float(np.square(values.astype(np.float64)).sum()), rtol=1e-6
+    )
+    assert len(h["bucket"]) == len(h["bucket_limit"]) == 30
+    assert sum(h["bucket"]) == values.size
+
+    h_const, _ = got["weights/const"]
+    assert h_const["num"] == 5 and sum(h_const["bucket"]) == 5
+    assert h_const["bucket_limit"][0] > 3.25  # (lo, hi] interval non-empty
+    h_empty, _ = got["weights/empty"]
+    assert h_empty["num"] == 0
+
+    # scalar reader ignores histogram events and vice versa
+    from fedcrack_tpu.obs import read_scalars
+
+    assert read_scalars(path) == []
+
+
+def test_tb_histograms_load_in_real_tensorboard(tmp_path):
+    """Acceptance bar for VERDICT r3 item 7: TensorBoard's own
+    event_accumulator must read our histogram summaries back."""
+    from fedcrack_tpu.obs import SummaryWriter
+
+    rng = np.random.default_rng(1)
+    with SummaryWriter(tmp_path) as w:
+        for step in (1, 2):
+            w.add_histogram("weights/dense", rng.normal(size=64) * step, step=step)
+
+    from tensorboard.backend.event_processing import event_accumulator
+
+    acc = event_accumulator.EventAccumulator(
+        str(tmp_path), size_guidance={event_accumulator.HISTOGRAMS: 0}
+    )
+    acc.Reload()
+    assert "weights/dense" in acc.Tags()["histograms"]
+    events = acc.Histograms("weights/dense")
+    assert [e.step for e in events] == [1, 2]
+    for e in events:
+        v = e.histogram_value
+        assert v.num == 64
+        assert len(v.bucket) == len(v.bucket_limit)
+        assert sum(v.bucket) == 64
+        assert v.min <= v.max
+
+
+def test_metrics_logger_tees_weight_histograms(tmp_path):
+    """log_histograms flattens a pytree into per-layer histogram tags; the
+    JSONL record of truth stays scalar-only."""
+    from fedcrack_tpu.obs import MetricsLogger, read_histograms
+
+    tree = {"conv": {"kernel": np.ones((3, 3)), "bias": np.zeros(4)}}
+    tb_dir = tmp_path / "tb"
+    with MetricsLogger(tmp_path / "m.jsonl", tb_dir=tb_dir) as m:
+        assert m.tb_enabled
+        m.log_histograms(3, tree, prefix="weights")
+    (event_file,) = list(tb_dir.iterdir())
+    got = {tag: step for tag, _, step in read_histograms(event_file)}
+    assert got == {"weights/conv/kernel": 3, "weights/conv/bias": 3}
+    assert (tmp_path / "m.jsonl").read_text() == ""
+
+    with MetricsLogger(tmp_path / "m2.jsonl") as m:  # no tb_dir -> no-op
+        assert not m.tb_enabled
+        m.log_histograms(1, tree)

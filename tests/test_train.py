@@ -303,3 +303,47 @@ def test_make_train_fn_honors_handshake_hparams():
     # no hparams -> client defaults (1 epoch, 2 more steps)
     train_fn(blob, 2)
     assert int(holder["state"].step) == 8
+
+
+def test_make_train_fn_tees_weight_histograms(tmp_path):
+    """With a TB-enabled metrics logger, each round's local fit emits
+    per-layer weight AND round-update (trained minus received params)
+    histograms — the reference's histogram_freq=1 callback
+    (client_fit_model.py:153-154)."""
+    import glob
+
+    from fedcrack_tpu.configs import DataConfig, FedConfig, ModelConfig
+    from fedcrack_tpu.data.pipeline import ArrayDataset
+    from fedcrack_tpu.data.synthetic import synth_crack_batch
+    from fedcrack_tpu.fed.serialization import tree_to_bytes
+    from fedcrack_tpu.obs import MetricsLogger, read_histograms
+    from fedcrack_tpu.train.federated import make_train_fn
+
+    cfg = FedConfig(
+        local_epochs=1,
+        model=ModelConfig(img_size=32),
+        data=DataConfig(img_size=32, batch_size=4),
+    )
+    images, masks = synth_crack_batch(8, img_size=32, seed=0)
+    dataset = ArrayDataset(images, masks, batch_size=4, seed=0)
+    logger = MetricsLogger(tmp_path / "m.jsonl", tb_dir=tmp_path / "tb")
+    train_fn, holder = make_train_fn(
+        cfg, dataset, batch_size=4, seed=0, metrics_logger=logger
+    )
+    blob = tree_to_bytes(holder["state"].variables)
+    train_fn(blob, 1)
+    logger.close()
+
+    (event_file,) = glob.glob(str(tmp_path / "tb" / "events.out.tfevents.*"))
+    got = read_histograms(event_file)
+    tags = {t for t, _, _ in got}
+    assert any(t.startswith("weights/") and t.endswith("kernel") for t in tags), tags
+    assert any(t.startswith("round_update/") for t in tags), tags
+    # every histogram is pinned to the round and structurally sound
+    for tag, h, step in got:
+        assert step == 1
+        assert len(h["bucket"]) == len(h["bucket_limit"])
+        assert sum(h["bucket"]) == h["num"]
+    # a trained param actually moved: its update histogram is not all-zero
+    updates = [h for t, h, _ in got if t.startswith("round_update/")]
+    assert any(h["min"] < 0 or h["max"] > 0 for h in updates)
