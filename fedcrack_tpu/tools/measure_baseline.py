@@ -244,7 +244,12 @@ def measure_c2(args, preset="c2_two_client_grpc", partition="iid", mu=None) -> d
 def measure_mesh(args, preset: str, n_clients: int, n_batch: int) -> dict:
     """c3/c5: one-program mesh rounds; quality from the final aggregate."""
     from fedcrack_tpu.data.synthetic import synth_crack_batch
-    from fedcrack_tpu.parallel import build_federated_round, make_mesh, stack_client_data
+    from fedcrack_tpu.parallel import (
+        build_federated_round,
+        make_mesh,
+        run_mesh_federation,
+        stack_client_data,
+    )
     from fedcrack_tpu.train.local import create_train_state
 
     cfg = _load_preset(preset)
@@ -271,14 +276,18 @@ def measure_mesh(args, preset: str, n_clients: int, n_batch: int) -> dict:
     active = np.ones(n_clients, np.float32)
     n_samples = np.full(n_clients, float(args.mesh_steps * batch), np.float32)
     state0 = create_train_state(jax.random.key(cfg.seed), model_cfg)
-    variables = state0.variables
 
-    times = []
-    for r in range(args.rounds):
-        t0 = _now()
-        variables, metrics = round_fn(variables, images, masks, active, n_samples)
-        float(np.asarray(metrics["loss"])[0])  # readback barrier
-        times.append(_now() - t0)
+    # Multi-round loop through the package driver (parallel.driver): local
+    # data is static across rounds, so data_fn returns None after round 0
+    # and the shard is staged exactly once.
+    variables, records = run_mesh_federation(
+        round_fn,
+        state0.variables,
+        lambda r: (images, masks, active, n_samples) if r == 0 else None,
+        args.rounds,
+        mesh,
+    )
+    times = [rec.wall_clock_s for rec in records]
     # first round includes compilation; report the post-compile median
     round_s = float(np.median(times[1:])) if len(times) > 1 else times[0]
     steps_per_round = args.epochs * args.mesh_steps
