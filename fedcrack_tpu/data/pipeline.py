@@ -110,6 +110,16 @@ def normalize_images(images):
     return images
 
 
+def to_uint8_transport(images: np.ndarray, masks: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Encode float32 model-contract arrays as uint8 transport bytes: images
+    [0,1] -> round-to-nearest u8 (the inverse of ``normalize_images``'s /255),
+    masks {0,1} -> u8 {0,1}. Single source for every producer of synthetic
+    uint8 staging data (bench.py, tools/refscale_federation) — the bit-exact
+    round-trip claim holds only if encode and decode stay paired."""
+    images_u8 = np.clip(np.rint(images * np.float32(255.0)), 0, 255).astype(np.uint8)
+    return images_u8, masks.astype(np.uint8)
+
+
 def as_model_batch(images, masks):
     """Normalize a transport batch (possibly uint8, see ``transport_dtype``)
     to the model contract: float32 [0,1] images, float32 {0,1} masks.

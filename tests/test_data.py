@@ -293,3 +293,24 @@ def test_train_and_eval_steps_accept_uint8_batches():
     e_u = eval_step(state, (img_u8, msk_u8))
     assert float(e_f["loss"]) == pytest.approx(float(e_u["loss"]), rel=1e-5)
     assert float(e_f["iou_inter"]) == pytest.approx(float(e_u["iou_inter"]), abs=1.0)
+
+
+def test_to_uint8_transport_matches_decode_contract():
+    """The shared synthetic-data uint8 encoder (bench + refscale tool) must
+    be the exact inverse of the on-device normalization: u8 = rint(f32*255),
+    masks {0,1} preserved — so uint8 staging of synthetic data keeps the
+    bit-exact round-trip the file-decode path guarantees."""
+    from fedcrack_tpu.data.pipeline import normalize_images, to_uint8_transport
+
+    rng = np.random.default_rng(0)
+    images = rng.uniform(0.0, 1.0, size=(4, 8, 8, 3)).astype(np.float32)
+    masks = (rng.uniform(size=(4, 8, 8, 1)) > 0.5).astype(np.float32)
+    u8i, u8m = to_uint8_transport(images, masks)
+    assert u8i.dtype == np.uint8 and u8m.dtype == np.uint8
+    np.testing.assert_array_equal(u8i, np.rint(images * 255.0).astype(np.uint8))
+    np.testing.assert_array_equal(u8m.astype(np.float32), masks)
+    # Round-trip through the on-device normalization: bit-exact u8 * (1/255)
+    # (NOT u8/255.0 — the multiply-by-reciprocal differs from true division
+    # by 1 ulp for ~half the byte values, and the multiply is the contract).
+    back = np.asarray(normalize_images(u8i))
+    np.testing.assert_array_equal(back, u8i.astype(np.float32) * np.float32(1.0 / 255.0))
