@@ -77,11 +77,14 @@ def write_synthetic_dataset(
     img_size: int = 128,
     seed: int = 0,
     crack_prob: float = 0.8,
+    min_thickness: int | None = None,
 ) -> tuple[str, str]:
     """Materialize a fixture dataset on disk in the reference's layout:
     paired files with identical stems under ``images/`` and ``masks/``
     (reference layout: crack_segmentation_dataset/train/{images,masks},
     test/Segmentation.py:13-17). Returns (image_dir, mask_dir).
+    ``min_thickness`` as in :func:`synth_crack_batch` (quality-gate fixtures
+    use a thick stroke).
     """
     import cv2
 
@@ -89,7 +92,7 @@ def write_synthetic_dataset(
     mask_dir = os.path.join(root, "masks")
     os.makedirs(image_dir, exist_ok=True)
     os.makedirs(mask_dir, exist_ok=True)
-    images, masks = synth_crack_batch(n, img_size, seed, crack_prob)
+    images, masks = synth_crack_batch(n, img_size, seed, crack_prob, min_thickness)
     for i in range(n):
         bgr = cv2.cvtColor((images[i] * 255).astype(np.uint8), cv2.COLOR_RGB2BGR)
         cv2.imwrite(os.path.join(image_dir, f"img_{i:05d}.jpg"), bgr)
