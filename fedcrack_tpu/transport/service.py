@@ -352,16 +352,9 @@ class FedServer:
                 self.state = R.drop_log(self.state, cname, title)
 
     def _build(self) -> grpc.aio.Server:
-        server = grpc.aio.server(options=channel_options(self.config.max_message_mb))
-        handler = grpc.stream_stream_rpc_method_handler(
-            self._session,
-            request_deserializer=pb.ClientMessage.FromString,
-            response_serializer=pb.ServerMessage.SerializeToString,
-        )
-        server.add_generic_rpc_handlers(
-            (grpc.method_handlers_generic_handler(SERVICE_NAME, {METHOD: handler}),)
-        )
-        address = f"{self.config.host}:{self.config.port}"
+        # Config validation BEFORE any aio construction: misconfiguration
+        # must surface as its own error, not whatever state the thread's
+        # event loop happens to be in.
         if self.config.tls_ca and not (self.config.tls_cert and self.config.tls_key):
             # tls_ca alone is a CLIENT configuration (root to verify the
             # server). A server launched with it but no cert/key would
@@ -372,6 +365,16 @@ class FedServer:
                 "server has tls_ca but no tls_cert/tls_key: client-cert "
                 "enforcement (mTLS) requires the server's own TLS identity"
             )
+        server = grpc.aio.server(options=channel_options(self.config.max_message_mb))
+        handler = grpc.stream_stream_rpc_method_handler(
+            self._session,
+            request_deserializer=pb.ClientMessage.FromString,
+            response_serializer=pb.ServerMessage.SerializeToString,
+        )
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE_NAME, {METHOD: handler}),)
+        )
+        address = f"{self.config.host}:{self.config.port}"
         if self.config.tls_cert and self.config.tls_key:
             # TLS server credentials (the reference served an insecure port
             # only, fl_server.py:218). With tls_ca set too, client certs

@@ -160,6 +160,51 @@ def test_first_round_data_required(round_fn_and_mesh):
         run_mesh_federation(round_fn, _init_vars(), _fresh_data_fn(), 0, mesh)
 
 
+def test_driver_drives_spatial_federated_round():
+    """The driver's ``image_spec`` parameter composes with the
+    spatially-sharded round builder: a Mesh(('clients','space')) federation
+    where each client's fit is halo-exchange sharded over image height,
+    driven for 2 rounds with per-round restaging."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from fedcrack_tpu.parallel import build_spatial_federated_round, make_mesh
+    from fedcrack_tpu.train.local import create_train_state
+
+    n_clients, n_space, steps, batch = 2, 2, 2, 2
+    # H=32 satisfies the 16 x n_space divisibility contract.
+    cfg = ModelConfig(
+        img_size=32, stem_features=4, encoder_features=(8,), decoder_features=(8, 4)
+    )
+    mesh = make_mesh(n_clients, n_space, axis_names=("clients", "space"))
+    round_fn = build_spatial_federated_round(
+        mesh, cfg, learning_rate=1e-3, local_epochs=1
+    )
+    spec = P("clients", None, None, "space")
+
+    def data_fn(r):
+        per_client = [
+            synth_crack_batch(steps * batch, img_size=32, seed=40 + 10 * r + i)
+            for i in range(n_clients)
+        ]
+        images, masks = stack_client_data(per_client, steps, batch)
+        active = np.ones(n_clients, np.float32)
+        n_samples = np.full(n_clients, float(steps * batch), np.float32)
+        return images, masks, active, n_samples
+
+    tmpl = create_train_state(jax.random.key(0), cfg)
+    variables, records = run_mesh_federation(
+        round_fn, tmpl.variables, data_fn, 2, mesh, image_spec=spec
+    )
+    assert len(records) == 2
+    assert records[0].overlapped and not records[1].overlapped
+    for rec in records:
+        assert np.isfinite(rec.metrics["loss"]).all()
+    assert all(
+        np.isfinite(np.asarray(l)).all() for l in jax.tree_util.tree_leaves(variables)
+    )
+
+
 @pytest.mark.slow
 def test_mesh_program_reaches_absolute_iou_floor():
     """Quality THROUGH the mesh program (round-3 verdict item 4): every

@@ -1,6 +1,7 @@
 """Crack quantifier: closed-form shapes and the predict flow."""
 
 import numpy as np
+import pytest
 
 from fedcrack_tpu.tools import quantify_mask
 from fedcrack_tpu.tools.quantify import annotate
@@ -66,3 +67,33 @@ def test_predict_and_quantify_writes_outputs(tmp_path):
     assert (tmp_path / "pred_000.png").exists()
     assert (tmp_path / "overlay_002.png").exists()
     assert all("area_px" in r for r in reports)
+
+
+@pytest.mark.slow
+def test_refscale_federation_tool_smoke(tmp_path):
+    """The reference-complete federation driver (tools/refscale_federation)
+    at toy scale: artifact schema, per-round eval records, and the driver
+    overlap wiring all exercised — the real run
+    (bench_runs/r04_refscale_federation.json) is this at 5x10x388."""
+    import json
+
+    from fedcrack_tpu.tools.refscale_federation import main
+
+    out = tmp_path / "refscale.json"
+    rc = main(
+        [
+            "--rounds", "2", "--epochs", "1", "--samples", "32", "--batch", "4",
+            "--img", "32", "--eval-samples", "8", "--dtype", "float32",
+            "--out", str(out),
+        ]
+    )
+    assert rc == 0
+    art = json.loads(out.read_text())
+    assert art["workload"]["rounds"] == 2
+    assert len(art["rounds"]) == 2
+    for r in art["rounds"]:
+        assert r["staged_bytes"] > 0
+        assert "iou" in r["eval"] and "loss" in r["eval"]
+    assert r["overlapped_next_round_staging"] is False  # last round: nothing to stage
+    assert art["rounds"][0]["overlapped_next_round_staging"] is True
+    assert len(art["summary"]["eval_iou_trajectory"]) == 2
