@@ -61,3 +61,40 @@ def test_bench_smoke_emits_driver_contract():
     # they can never under-explain the round).
     assert reconstructed >= host["round_ms"] * 0.98
     assert detail["vs_baseline_compute_only"] > 0
+
+
+@pytest.mark.slow
+def test_bench_budget_skips_sections_but_still_emits():
+    """The round-4 budget machinery: with an already-exhausted budget the
+    mandatory flagship-size sweep + host plane still run and the JSON still
+    prints (rc 0), while the optional secondary-size sweep is skipped WITH a
+    record under detail.skipped — never silently."""
+    env = dict(os.environ)
+    env.update(
+        FEDCRACK_BENCH_FORCE_CPU="1",
+        FEDCRACK_BENCH_STEPS="2",
+        FEDCRACK_BENCH_BATCH="4",
+        FEDCRACK_BENCH_REPS="1",
+        FEDCRACK_BENCH_SIZES="32,48",  # 48 = the optional secondary size
+        FEDCRACK_BENCH_BUDGET_S="1",  # exhausted before any optional section
+    )
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py")],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=900,
+        cwd=root,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    detail = out["detail"]
+    # Mandatory sections completed and priced the headline.
+    assert set(detail["sweep"]) == {"float32_32", "bfloat16_32"}
+    assert out["value"] > 0 and out["vs_baseline"] > 0
+    # The optional 48px sweep was skipped and RECORDED, not silently dropped.
+    skipped = {s["section"]: s for s in detail["skipped"]}
+    assert "sweep_48" in skipped
+    assert skipped["sweep_48"]["reason"] == "estimate exceeds remaining budget"
+    assert detail["budget"]["budget_s"] == 1.0
