@@ -102,6 +102,12 @@ def run_mesh_federation(
     Returns the final global ``variables`` (on device) and one
     :class:`RoundRecord` per round. The first round's wall-clock includes
     XLA compilation; report post-compile medians from ``records[1:]``.
+
+    Single-process staging only: ``stage_round_data`` device_puts host
+    arrays this process can address in full. A multi-host job stages each
+    process's client shards with ``jax.make_array_from_process_local_data``
+    (see ``parallel.multihost`` and tests/test_multihost.py) and should
+    drive its own round loop around ``round_fn``.
     """
     if n_rounds <= 0:
         raise ValueError(f"n_rounds must be positive, got {n_rounds}")
