@@ -5,11 +5,14 @@ Round 3's lesson (VERDICT.md round-3 item 1): a bench that only proves its
 claims given unbounded time proves nothing under a driver — `BENCH_r03.json`
 was an empty timeout. This bench is budget-aware:
 
-- **Sections run value-first, cheapest-first**: the {f32, bf16} sweep at the
-  flagship size and the decomposed host plane (cheap, and every ratio needs
-  them) always run; the reference-scale points (the expensive part: hundreds
-  of MB staged through a ~30 MB/s tunnel per point) and the secondary-size
-  sweep are each gated on a cost estimate fitting the remaining budget.
+- **Sections run value-first**: the {f32, bf16} sweep at the flagship size
+  always runs, the reference-scale points (the headline) run IMMEDIATELY
+  after it, and the host plane runs after those — round 4's lesson
+  (VERDICT round-4 weak #1): under a congested tunnel the host plane cost
+  240 s and starved the headline sections out of the driver's budget, so
+  the headline now outranks it. The host plane, batch-scaling curve, and
+  secondary-size sweep are each gated on a cost estimate fitting the
+  remaining budget (the host plane degrades to fewer reps before skipping).
 - **`FEDCRACK_BENCH_BUDGET_S`** (default 780 s) is the wall-clock budget.
   When a section doesn't fit, it is SKIPPED and recorded under
   `detail.skipped` with the estimate that excluded it — the JSON always
@@ -38,6 +41,14 @@ Measurement design (unchanged from round 3, validated in bench_runs/):
    program, with uint8 staging and the double-buffered next-round overlap
    driven through `parallel.driver.run_mesh_federation` (the production
    component, not a bench-local loop).
+4. **Input pipeline** (round 5): the reference's synchronous per-batch cv2
+   decode cost (client_fit_model.py:30-43 runs 16 imread+resize per step
+   inside fit), measured on this host and folded into the host-plane
+   reconstruction as a separate labeled term — the decode-inclusive
+   co-located ratio the round-4 verdict asked for.
+5. **Batch curve** (round 5): bf16 flagship per-step/MFU at batch {32, 64}
+   from on-device regrouped sweep data — evidence for/against the
+   width-bound MFU-ceiling claim (batch 16 stays the parity headline).
 
 Prints ONE JSON line: value = flagship one-program round wall-clock (ms) at
 reference scale when measured (sweep scale otherwise); vs_baseline =
@@ -79,11 +90,13 @@ REF_SCALE = os.environ.get("FEDCRACK_BENCH_REF_SCALE", "auto")
 REF_256 = os.environ.get("FEDCRACK_BENCH_REF_256", "0") == "1"
 
 # Default sized from measured section costs on the TPU-tunnel host (round 4):
-# sweep_128 ~260 s + host ~75 s + ref bf16 ~233 s + ref f32 ~132 s ≈ 700 s on
+# sweep_128 ~260 s + ref bf16 ~233 s + ref f32 ~132 s + host ~75 s ≈ 700 s on
 # a warm compilation cache (big-program cache loads still ship executables
 # through the ~30 MB/s tunnel — they are not free). 780 keeps both
-# reference-scale points inside the budget warm, and degrades to
-# sweep+host-only (still a complete r02-level artifact, rc 0) when cold.
+# reference-scale points inside the budget warm (they run right after the
+# sweep, so congestion degrades the TAIL sections — host plane, batch curve,
+# 256 sweep — not the headline), and degrades to a sweep-only r02-level
+# artifact when cold.
 BUDGET_S = float(os.environ.get("FEDCRACK_BENCH_BUDGET_S", "780"))
 _START = time.monotonic()
 
@@ -135,8 +148,17 @@ def _emit() -> None:
 
 def _install_signal_net() -> None:
     def handler(signum, frame):
+        # Mark the artifact as interrupted (a run killed mid-section must be
+        # distinguishable from one where later sections simply never ran) and
+        # exit 128+signum so the rc says so too.
+        if _OUT["payload"] is not None:
+            try:
+                name = signal.Signals(signum).name
+            except ValueError:
+                name = str(signum)
+            _OUT["payload"]["interrupted"] = name
         _emit()
-        os._exit(0)
+        os._exit(128 + signum)
 
     for sig in (signal.SIGTERM, signal.SIGINT):
         try:
@@ -254,9 +276,11 @@ def _sweep_size(
     img: int, mesh, n_clients: int, device, peak, sweep: dict, checkpoint=None
 ):
     """Both dtypes at one crop size; returns the per-client float32 sample
-    arrays (the host plane reuses them) and the f32 initial state.
-    ``checkpoint`` (if given) is called after each completed point so a
-    mid-sweep TERM still ships the points that finished."""
+    arrays (the host plane reuses them), the f32 initial state, and the
+    staged short-scan device arrays (the batch curve regroups them on device
+    instead of re-shipping bytes). ``checkpoint`` (if given) is called after
+    each completed point so a mid-sweep TERM still ships the points that
+    finished."""
     from fedcrack_tpu.configs import ModelConfig
     from fedcrack_tpu.obs.flops import mfu, train_step_flops
     from fedcrack_tpu.parallel import build_federated_round, stack_client_data
@@ -328,7 +352,7 @@ def _sweep_size(
         }
         if checkpoint is not None:
             checkpoint()
-    return per_client, f32_state0
+    return per_client, f32_state0, (si, sm)
 
 
 def _step_s(point) -> float:
@@ -340,8 +364,11 @@ def _step_s(point) -> float:
     return point["round_s_raw"] / STEPS
 
 
-def _measure_host_plane(n_clients, variables, per_client, state0):
-    """The reference architecture, decomposed. Returns (total_s, parts)."""
+def _measure_host_plane(n_clients, variables, per_client, state0, reps=REPS):
+    """The reference architecture, decomposed. Returns (total_s, parts).
+    ``reps`` shrinks the median sample when the remaining budget is tight
+    (a 1-rep host round beats a skipped host plane; the artifact records
+    the rep count used)."""
     from fedcrack_tpu.fed.algorithms import fedavg
     from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
     from fedcrack_tpu.train.local import train_step
@@ -372,7 +399,7 @@ def _measure_host_plane(n_clients, variables, per_client, state0):
         return avg
 
     host_round()  # warm-up: compiles train_step at this shape
-    total_s = _median_time(host_round)
+    total_s = _median_time(host_round, reps=reps)
 
     # Serialization cost, measured on the same pytree: per round the host
     # plane serializes 1 broadcast + C uploads and parses 2C blobs
@@ -396,6 +423,198 @@ def _measure_host_plane(n_clients, variables, per_client, state0):
         "from_bytes_s_raw": from_s,
         "fedavg_s_raw": fedavg_s,
     }
+
+
+def _batch_curve(
+    img: int, mesh, n_clients, device, peak, si, sm, curve: dict, checkpoint=None
+):
+    """bf16 per-step time + MFU at batch {32, 64} (batch 16 is the sweep's
+    flagship point). Substantiates BASELINE.md's width-bound-ceiling claim:
+    if the model's 32-256-lane widths are the bottleneck, larger batches
+    occupy more MXU rows at the same lane width and MFU should rise.
+
+    Data is the flagship sweep's staged float32 arrays regrouped ON DEVICE
+    ([C, S, B, ...] -> [C, S/f, f*B, ...]) — same bytes, same total samples
+    per round, zero extra tunnel transfer. Batch 16 remains the parity
+    headline (the reference's batch, client_fit_model.py:55-56); this curve
+    is a non-parity appendix."""
+    from fedcrack_tpu.configs import ModelConfig
+    from fedcrack_tpu.obs.flops import mfu, train_step_flops
+    from fedcrack_tpu.parallel import build_federated_round
+    from fedcrack_tpu.train.local import create_train_state
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    config = ModelConfig(img_size=img, compute_dtype="bfloat16")
+    state0 = create_train_state(jax.random.key(SEED), config)
+    round_fn = build_federated_round(mesh, config, learning_rate=1e-3, local_epochs=1)
+    active = np.ones(n_clients, np.float32)
+    sharding = NamedSharding(mesh, P(CLIENTS_AX, None, BATCH_AX))
+
+    for b in (32, 64):
+        factor = b // BATCH
+        if factor < 1 or b % BATCH:
+            continue  # smoke-test batch overrides can make this degenerate
+        steps_b = STEPS // factor
+        if steps_b < 2 or steps_b * factor != STEPS:
+            continue  # regroup must preserve element count (steps override)
+
+        def regroup(a):
+            out = jax.jit(
+                lambda t: t.reshape(t.shape[0], steps_b, b, *t.shape[3:]),
+                out_shardings=sharding,
+            )(a)
+            jax.block_until_ready(out)
+            return out
+
+        bi, bm = regroup(si), regroup(sm)
+        bi_long = _tile_steps(bi, FIT_FACTOR, mesh)
+        bm_long = _tile_steps(bm, FIT_FACTOR, mesh)
+        n_samp = np.full(n_clients, float(steps_b * b), np.float32)
+
+        def timed(data_i, data_m):
+            run = _make_round_runner(
+                round_fn, state0.variables, data_i, data_m, active, n_samp
+            )
+            run()
+            run()
+            return _median_time(run)
+
+        short_s = timed(bi, bm)
+        long_s = timed(bi_long, bm_long)
+        slope_s = (long_s - short_s) / ((FIT_FACTOR - 1) * steps_b)
+        fit_ok = slope_s > 0.0
+        flops = train_step_flops(config, b)
+        curve[f"bfloat16_{img}_b{b}"] = {
+            "dtype": "bfloat16",
+            "img_size": img,
+            "batch": b,
+            "steps": steps_b,
+            "round_s_raw": short_s,
+            "per_step_s_raw": slope_s if fit_ok else None,
+            "round_ms": round(short_s * 1e3, 2),
+            "per_step_ms": round(slope_s * 1e3, 3) if fit_ok else None,
+            "per_sample_ms": round(slope_s / b * 1e3, 4) if fit_ok else None,
+            "flops_per_step": flops,
+            "mfu": (
+                round(mfu(slope_s, flops, device), 4)
+                if fit_ok and peak is not None
+                else None
+            ),
+        }
+        del bi, bm, bi_long, bm_long
+        if checkpoint is not None:
+            checkpoint()
+
+
+def _measure_input_pipeline(img: int) -> dict | None:
+    """The reference's synchronous per-step input cost, measured on this host.
+
+    The reference decodes its batch INSIDE the training loop: 16 x
+    cv2.imread + cvtColor(BGR2RGB) + resize for images and 16 x imread +
+    resize + binarize for masks, in ``__getitem__``, before EVERY step of
+    every epoch (client_fit_model.py:30-43; keras Sequence with no
+    prefetch workers wired, SURVEY.md §3.3). The host-plane reconstruction
+    used to charge the reference ZERO for this (VERDICT round-4 weak #4);
+    this section measures it so the co-located ratio can include it as a
+    separate, labeled term.
+
+    Measured variants: the reference's verbatim cv2 sequence (when cv2 is
+    importable — the reference hard-requires it) and this framework's own
+    ``data.pipeline.load_example`` decode (cv2 or PIL+native, whichever
+    backend this host has). Source resolutions 227 and 448 px bracket
+    public crack-segmentation datasets (SDNET2018-style 256-class patches
+    to khanhha-style 448 tiles); the CHARGED term is the cheapest measured
+    variant at the smallest source — a conservative lower bound.
+    """
+    import tempfile
+
+    try:
+        import cv2
+    except Exception:
+        cv2 = None
+
+    out: dict = {"batch": BATCH, "target_px": img, "variants": {}}
+    with tempfile.TemporaryDirectory() as td:
+        for src in (227, 448):
+            imgs_f, masks_f = _synth(BATCH, src, SEED)
+            u8 = np.clip(imgs_f * 255.0, 0, 255).astype(np.uint8)
+            m8 = (masks_f[..., 0] > 0.5).astype(np.uint8) * 255
+            img_paths, mask_paths = [], []
+            for i in range(BATCH):
+                ip = os.path.join(td, f"img_{src}_{i}.jpg")
+                mp = os.path.join(td, f"mask_{src}_{i}.png")
+                if cv2 is not None:
+                    cv2.imwrite(ip, cv2.cvtColor(u8[i], cv2.COLOR_RGB2BGR))
+                    cv2.imwrite(mp, m8[i])
+                else:
+                    from PIL import Image
+
+                    Image.fromarray(u8[i]).save(ip, quality=95)
+                    Image.fromarray(m8[i]).save(mp)
+                img_paths.append(ip)
+                mask_paths.append(mp)
+
+            variants = {}
+            if cv2 is not None:
+
+                def ref_step():
+                    np.array(
+                        [
+                            cv2.resize(
+                                cv2.cvtColor(cv2.imread(p, -1), cv2.COLOR_BGR2RGB),
+                                (img, img),
+                            )
+                            for p in img_paths
+                        ]
+                    ) / 255
+                    np.expand_dims(
+                        np.array(
+                            [
+                                (cv2.resize(cv2.imread(p, -1), (img, img)) > 0).astype(
+                                    np.uint8
+                                )
+                                for p in mask_paths
+                            ]
+                        ),
+                        -1,
+                    )
+
+                ref_step()
+                variants["reference_cv2"] = _median_time(ref_step, reps=5)
+
+            from fedcrack_tpu.data.pipeline import load_example
+
+            def our_step():
+                for ip, mp in zip(img_paths, mask_paths):
+                    load_example(ip, mp, img_size=img, transport_dtype="uint8")
+
+            our_step()
+            variants["framework_load_sample"] = _median_time(our_step, reps=5)
+            out["variants"][f"src{src}"] = {
+                k: {
+                    "batch_ms": round(v * 1e3, 2),
+                    "per_image_ms": round(v / BATCH * 1e3, 3),
+                }
+                for k, v in variants.items()
+            }
+
+    candidates = [
+        v * 1e-3
+        for by_src in out["variants"].values()
+        for v in [x["batch_ms"] for x in by_src.values()]
+    ]
+    if not candidates:
+        return None
+    out["charged_per_step_s_raw"] = min(candidates)
+    out["charged_per_step_ms"] = round(out["charged_per_step_s_raw"] * 1e3, 2)
+    out["note"] = (
+        "charged term = cheapest measured variant (conservative bound for "
+        "the reference's per-step input cost); the mesh plane decodes each "
+        "image once into a uint8 pool and restages it overlapped "
+        "(parallel.driver), so its per-step input cost is ~0"
+    )
+    return out
 
 
 def _ref_host_arrays(img: int):
@@ -630,7 +849,7 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
         )
 
     _sweep_checkpoint()
-    flagship_per_client, f32_state0 = _sweep_size(
+    flagship_per_client, f32_state0, (flag_si, flag_sm) = _sweep_size(
         SIZES[0], mesh, n_clients, device, peak, sweep, checkpoint=_sweep_checkpoint
     )
     section_s[f"sweep_{SIZES[0]}"] = time.monotonic() - t0
@@ -668,48 +887,10 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
     # Safety-net payload before the host plane exists (vs_baseline unknowable).
     _set_payload(metric_sweep, sweep[bf16_key]["round_ms"], None, detail)
 
-    # ---- mandatory: host plane (reference architecture) ----
-    t0 = time.monotonic()
-    host_total_s, host_parts = _measure_host_plane(
-        n_clients, f32_state0.variables, flagship_per_client, f32_state0
-    )
-    section_s["host_plane"] = time.monotonic() - t0
-    # Compute-only reconstruction of a host round: the same SGD step costs
-    # what the mesh plane's scan charges per step (identical XLA program);
-    # everything above that is the host architecture's own overhead.
-    compute_s = n_clients * STEPS * _step_s(sweep[f32_key])
-    ser_s = host_parts["serialization_ms"] / 1e3
-    agg_s = host_parts["host_fedavg_ms"] / 1e3
-    dispatch_s = max(0.0, host_total_s - compute_s - ser_s - agg_s)
-    compute_only_s = compute_s + ser_s + agg_s
-
-    detail["host_plane"] = {
-        "dtype": "float32",
-        "img_size": SIZES[0],
-        "round_ms": round(host_total_s * 1e3, 2),
-        "per_step_compute_ms": round(_step_s(sweep[f32_key]) * 1e3, 3),
-        "serialization_ms": round(host_parts["serialization_ms"], 2),
-        "host_fedavg_ms": round(host_parts["host_fedavg_ms"], 2),
-        "dispatch_overhead_ms": round(dispatch_s * 1e3, 2),
-        "note": (
-            "dispatch_overhead is per-step Python dispatch + host<->device "
-            "transfer round-trips; through a remote-device tunnel it is "
-            "dominated by tunnel latency and is NOT a compute advantage"
-        ),
-    }
-    # Same-architecture-work ratio, dispatch excluded on BOTH sides: host
-    # round rebuilt from its compute + serialization + aggregation parts,
-    # over the mesh round's slope-based (dispatch-free) time.
-    detail["vs_baseline_compute_only"] = round(compute_only_s / mesh_f32_compute_s, 3)
-    # Measured end-to-end ratio against the bf16 flagship.
-    detail["vs_baseline_vs_flagship"] = round(host_total_s / mesh_bf16_s, 3)
-    detail["budget"] = _budget_detail()
-    value = sweep[bf16_key]["round_ms"]
-    vs_baseline = round(host_total_s / mesh_f32_s, 3)
-    # Minimal complete output (the round-2 contract): sweep-scale headline.
-    _set_payload(metric_sweep, value, vs_baseline, detail)
-
-    # ---- reference-scale points, budget-gated (the expensive part) ----
+    # ---- reference-scale points, budget-gated — the HEADLINE, so they run
+    # immediately after the flagship sweep (round-4 weak #1: the host plane
+    # used to run first and a congested tunnel starved these out of the
+    # driver's budget two rounds running) ----
     run_ref = REF_SCALE == "1" or (
         REF_SCALE == "auto" and getattr(device, "platform", "") == "tpu"
     )
@@ -773,32 +954,14 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
         # remaining sections — drop it before the 256px staging below.
         reuse = None
 
+    ref_bf16 = reference_scale.get(f"bfloat16_{SIZES[0]}")
+    ref_f32 = reference_scale.get(f"float32_{SIZES[0]}")
+    metric_headline = metric_sweep
+    value = sweep[bf16_key]["round_ms"]
+    vs_baseline = None
+    mesh_ref_f32_s = None
     if reference_scale:
-        # Headline restated AT THE REFERENCE'S SCALE: 10 epochs x ~388 steps
-        # per round. The host plane at that scale is reconstructed from
-        # measured components — per-step compute slope, per-step dispatch
-        # overhead from the measured STEPS-step host round, serialization,
-        # host FedAvg — because driving 3,880 Python-dispatched steps through
-        # the tunnel per rep is minutes per measurement for no added
-        # information. Both the tunnel-inclusive ratio and the dispatch-free
-        # compute-only floor are reported.
-        per_step_overhead_s = dispatch_s / max(1, n_clients * STEPS)
-        # 1-client serialization shape: 1 broadcast + 1 upload serialized,
-        # 1 client parse + 1 server parse (NOT this run's n_clients total).
-        ser_ref_s = (
-            2 * host_parts["to_bytes_s_raw"] + 2 * host_parts["from_bytes_s_raw"]
-        )
-        agg_ref_s = host_parts["fedavg_s_raw"]
-        host_ref_s = (
-            total_steps * (_step_s(sweep[f32_key]) + per_step_overhead_s)
-            + ser_ref_s
-            + agg_ref_s
-        )
-        host_ref_compute_s = (
-            total_steps * _step_s(sweep[f32_key]) + ser_ref_s + agg_ref_s
-        )
-        ref_bf16 = reference_scale.get(f"bfloat16_{SIZES[0]}")
-        ref_f32 = reference_scale.get(f"float32_{SIZES[0]}")
+        detail["reference_scale"] = reference_scale
         # Ratio denominator: the measured f32 ref round when it ran; else the
         # slope-reconstructed f32 round (conservative — slope excludes the
         # one-dispatch cost the measured round would include).
@@ -808,26 +971,196 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
         else:
             mesh_ref_f32_s = _step_s(sweep[f32_key]) * total_steps
             denom_note = "slope-reconstructed f32 round (f32 ref point skipped)"
-        detail["reference_scale"] = reference_scale
-        detail["host_ref_reconstructed_s"] = round(host_ref_s, 3)
-        detail["vs_baseline_ref_compute_only"] = round(
-            host_ref_compute_s / mesh_ref_f32_s, 3
-        )
-        metric = (
-            f"reference-scale one-program FedAvg round wall-clock "
-            f"(1 client, {SIZES[0]}x{SIZES[0]}, bf16 compute, b{BATCH}, "
-            f"{REF_EPOCHS} epochs x {REF_STEPS} steps = {total_steps} steps, "
-            f"uint8 staging); vs_baseline = reconstructed host/gRPC-style "
-            f"plane over {denom_note} at equal float32 dtype, "
-            f"tunnel-inclusive (detail.vs_baseline_ref_compute_only is the "
-            f"dispatch-free floor; detail.reference_scale has the "
-            f"staging/compute/overlap decomposition)"
-        )
         if ref_bf16 is not None:
+            # The metric/value pair switches to reference scale ONLY when the
+            # bf16 reference-scale point actually landed (round-4 advisor
+            # finding: an aborted bf16 point must not leave a reference-scale
+            # metric string over a sweep-scale value).
             value = ref_bf16["round_ms"]
-        vs_baseline = round(host_ref_s / mesh_ref_f32_s, 3)
+            metric_headline = (
+                f"reference-scale one-program FedAvg round wall-clock "
+                f"(1 client, {SIZES[0]}x{SIZES[0]}, bf16 compute, b{BATCH}, "
+                f"{REF_EPOCHS} epochs x {REF_STEPS} steps = {total_steps} steps, "
+                f"uint8 staging); vs_baseline = reconstructed host/gRPC-style "
+                f"plane over {denom_note} at equal float32 dtype, "
+                f"tunnel-inclusive (detail.vs_baseline_ref_compute_only is the "
+                f"dispatch-free floor; detail.reference_scale has the "
+                f"staging/compute/overlap decomposition)"
+            )
+        else:
+            metric_headline = metric_sweep + (
+                " [bf16 reference-scale point missing: value stays "
+                "sweep-scale; vs_baseline is the reference-scale f32 ratio "
+                "when reference_scale is non-empty; detail.reference_scale "
+                "holds what landed]"
+            )
         detail["budget"] = _budget_detail()
-        _set_payload(metric, value, vs_baseline, detail)
+        _set_payload(metric_headline, value, vs_baseline, detail)
+
+    # ---- host plane (reference architecture) — AFTER the headline sections
+    # (round-4 weak #1: it cost 240 s under a congested tunnel and starved
+    # them); degrades to a 1-rep median, then to a recorded skip ----
+    host_parts = None
+    host_total_s = None
+    host_round_est = n_clients * STEPS * (_step_s(sweep[f32_key]) + 0.12) + 2.0
+    for host_reps in (REPS, 1):
+        host_est = COMPILE_EST_S + (1 + host_reps) * host_round_est + 5.0
+        if _fits(host_est):
+            t0 = time.monotonic()
+            host_total_s, host_parts = _measure_host_plane(
+                n_clients,
+                f32_state0.variables,
+                flagship_per_client,
+                f32_state0,
+                reps=host_reps,
+            )
+            section_s["host_plane"] = time.monotonic() - t0
+            break
+    else:
+        _skip(skips, "host_plane", host_est, "estimate exceeds remaining budget")
+        if "reconstructed host/gRPC-style" in metric_headline:
+            # The ref-scale metric text promises a host-plane ratio that now
+            # cannot be computed — annotate rather than mislabel (the same
+            # labeling-honesty class as the round-4 metric/value fix).
+            metric_headline += (
+                " [host plane budget-skipped: vs_baseline unavailable this run]"
+            )
+            _set_payload(metric_headline, value, vs_baseline, detail)
+
+    host_ref_s = None
+    host_ref_compute_s = None
+    if host_parts is not None:
+        # Compute-only reconstruction of a host round: the same SGD step costs
+        # what the mesh plane's scan charges per step (identical XLA program);
+        # everything above that is the host architecture's own overhead.
+        compute_s = n_clients * STEPS * _step_s(sweep[f32_key])
+        ser_s = host_parts["serialization_ms"] / 1e3
+        agg_s = host_parts["host_fedavg_ms"] / 1e3
+        dispatch_s = max(0.0, host_total_s - compute_s - ser_s - agg_s)
+        compute_only_s = compute_s + ser_s + agg_s
+
+        detail["host_plane"] = {
+            "dtype": "float32",
+            "img_size": SIZES[0],
+            "round_ms": round(host_total_s * 1e3, 2),
+            "reps": host_reps,
+            "per_step_compute_ms": round(_step_s(sweep[f32_key]) * 1e3, 3),
+            "serialization_ms": round(host_parts["serialization_ms"], 2),
+            "host_fedavg_ms": round(host_parts["host_fedavg_ms"], 2),
+            "dispatch_overhead_ms": round(dispatch_s * 1e3, 2),
+            "note": (
+                "dispatch_overhead is per-step Python dispatch + host<->device "
+                "transfer round-trips; through a remote-device tunnel it is "
+                "dominated by tunnel latency and is NOT a compute advantage"
+            ),
+        }
+        # Same-architecture-work ratio, dispatch excluded on BOTH sides: host
+        # round rebuilt from its compute + serialization + aggregation parts,
+        # over the mesh round's slope-based (dispatch-free) time.
+        detail["vs_baseline_compute_only"] = round(
+            compute_only_s / mesh_f32_compute_s, 3
+        )
+        # Measured end-to-end ratio against the bf16 flagship.
+        detail["vs_baseline_vs_flagship"] = round(host_total_s / mesh_bf16_s, 3)
+
+        if reference_scale:
+            # Host plane restated AT THE REFERENCE'S SCALE: reconstructed from
+            # measured components — per-step compute slope, per-step dispatch
+            # overhead from the measured STEPS-step host round, serialization,
+            # host FedAvg — because driving 3,880 Python-dispatched steps
+            # through the tunnel per rep is minutes per measurement for no
+            # added information.
+            per_step_overhead_s = dispatch_s / max(1, n_clients * STEPS)
+            # 1-client serialization shape: 1 broadcast + 1 upload serialized,
+            # 1 client parse + 1 server parse (NOT this run's n_clients total).
+            ser_ref_s = (
+                2 * host_parts["to_bytes_s_raw"]
+                + 2 * host_parts["from_bytes_s_raw"]
+            )
+            agg_ref_s = host_parts["fedavg_s_raw"]
+            host_ref_s = (
+                total_steps * (_step_s(sweep[f32_key]) + per_step_overhead_s)
+                + ser_ref_s
+                + agg_ref_s
+            )
+            host_ref_compute_s = (
+                total_steps * _step_s(sweep[f32_key]) + ser_ref_s + agg_ref_s
+            )
+            detail["host_ref_reconstructed_s"] = round(host_ref_s, 3)
+            detail["vs_baseline_ref_compute_only"] = round(
+                host_ref_compute_s / mesh_ref_f32_s, 3
+            )
+            vs_baseline = round(host_ref_s / mesh_ref_f32_s, 3)
+        else:
+            vs_baseline = round(host_total_s / mesh_f32_s, 3)
+        detail["budget"] = _budget_detail()
+        _set_payload(metric_headline, value, vs_baseline, detail)
+
+    # ---- input pipeline: the reference's synchronous per-step decode cost
+    # (host-CPU-only, cheap — no tunnel traffic) — closes the
+    # decode-exclusive-reconstruction caveat (round-4 weak #4) ----
+    input_pipeline = None
+    if _fits(20.0):
+        t0 = time.monotonic()
+        try:
+            input_pipeline = _measure_input_pipeline(SIZES[0])
+        except Exception as e:  # a host-only extra must never kill the artifact
+            input_pipeline = {"error": repr(e)}
+        section_s["input_pipeline"] = time.monotonic() - t0
+    else:
+        _skip(skips, "input_pipeline", 20.0, "estimate exceeds remaining budget")
+    if input_pipeline is not None:
+        detail["input_pipeline"] = input_pipeline
+        dec = input_pipeline.get("charged_per_step_s_raw")
+        if dec is not None and host_ref_s is not None:
+            # Decode-inclusive reconstruction: the reference pays BATCH
+            # synchronous image+mask decodes before every step (inside fit);
+            # the mesh plane's input cost is already inside its measured
+            # round (uint8 pool staged + overlapped by parallel.driver).
+            detail["host_ref_with_input_s"] = round(
+                host_ref_s + total_steps * dec, 3
+            )
+            detail["vs_baseline_ref_with_input"] = round(
+                (host_ref_s + total_steps * dec) / mesh_ref_f32_s, 3
+            )
+            detail["vs_baseline_ref_compute_plus_input"] = round(
+                (host_ref_compute_s + total_steps * dec) / mesh_ref_f32_s, 3
+            )
+        detail["budget"] = _budget_detail()
+        _set_payload(metric_headline, value, vs_baseline, detail)
+
+    # ---- batch-scaling curve (bf16 flagship at batch 32/64; non-parity
+    # appendix substantiating the width-bound-ceiling claim) ----
+    curve: dict = {}
+    bf16_round_s = sweep[bf16_key]["round_s_raw"]
+    curve_est = (
+        2 * (2 + REPS) * (1 + FIT_FACTOR) * bf16_round_s + 4 * COMPILE_EST_S + 5.0
+    )
+    if _fits(curve_est):
+
+        def _curve_checkpoint():
+            detail["batch_curve"] = curve
+            detail["budget"] = _budget_detail()
+            _set_payload(metric_headline, value, vs_baseline, detail)
+
+        t0 = time.monotonic()
+        _batch_curve(
+            SIZES[0],
+            mesh,
+            n_clients,
+            device,
+            peak,
+            flag_si,
+            flag_sm,
+            curve,
+            checkpoint=_curve_checkpoint,
+        )
+        section_s["batch_curve"] = time.monotonic() - t0
+        _curve_checkpoint()
+    else:
+        _skip(skips, "batch_curve", curve_est, "estimate exceeds remaining budget")
+    # The staged flagship arrays are dead weight for the remaining sections.
+    del flag_si, flag_sm
 
     # ---- secondary sweep sizes (MFU completeness; least load-bearing) ----
     for img in SIZES[1:]:
@@ -850,10 +1183,7 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
         _sweep_size(img, mesh, n_clients, device, peak, sweep)
         section_s[f"sweep_{img}"] = time.monotonic() - t0
         detail["budget"] = _budget_detail()
-        _set_payload(
-            _OUT["payload"]["metric"], _OUT["payload"]["value"],
-            _OUT["payload"]["vs_baseline"], detail,
-        )
+        _set_payload(metric_headline, value, vs_baseline, detail)
 
     # ---- opt-in: the ~10 min bf16/256 reference-scale point ----
     if run_ref and REF_256 and len(SIZES) > 1:

@@ -37,7 +37,13 @@ class RoundRecord:
 
     round_idx: int
     metrics: dict[str, np.ndarray]  # per-client leaves from the round program
-    wall_clock_s: float  # dispatch -> metrics readback (incl. overlapped staging)
+    # dispatch -> metrics readback. In overlap mode the NEXT round's data_fn
+    # and staging ride under the in-flight round, so their host time is
+    # EMBEDDED in this wall — summing wall_clock_s + data_fn_s across records
+    # double-counts data_fn. Sum wall_clock_s alone for session time. In
+    # sequential mode (overlap_staging=False) data_fn/staging run after the
+    # round barrier, so wall_clock_s is a pure round time.
+    wall_clock_s: float
     data_fn_s: float  # host time data_fn spent producing THIS round's data
     staging_s: float  # sequential-mode next-round staging (0 when overlapped)
     staged_bytes: int  # bytes newly staged for THIS round (0 = buffers reused)
@@ -88,8 +94,10 @@ def run_mesh_federation(
       active, n_samples)`` numpy arrays, or ``None`` to reuse round
       ``r-1``'s staged buffers and cohort (a client whose local dataset
       doesn't change between rounds should not re-ship it). ``data_fn(0)``
-      must return data. Called for round ``r+1`` while round ``r`` runs on
-      device, so per-round synthesis/shuffle cost also hides under compute.
+      must return data. With ``overlap_staging`` on, ``data_fn(r+1)`` is
+      called while round ``r`` runs on device, so per-round synthesis/
+      shuffle cost also hides under compute; with it off, it is called after
+      round ``r``'s barrier, so sequential timing charges it separately.
     - ``overlap_staging``: stage round r+1 while round r's program runs
       (double buffering). ``False`` serializes staging after the round
       barrier — the two orders produce bit-identical weights (staging is
@@ -131,7 +139,11 @@ def run_mesh_federation(
         next_cohort = None
         next_host = None
         next_data_s = 0.0
-        if r + 1 < n_rounds:
+        if overlap_staging and r + 1 < n_rounds:
+            # The round program is in flight; data_fn's host work and the
+            # staging transfers ride under it (the barrier inside
+            # stage_round_data only waits for the *transfer*, not the round),
+            # which is why this round's wall embeds them — see RoundRecord.
             td = time.perf_counter()
             nxt = data_fn(r + 1)
             next_data_s = time.perf_counter() - td
@@ -139,21 +151,27 @@ def run_mesh_federation(
                 ni, nm, na, nn = nxt
                 next_host = (ni, nm)
                 next_cohort = (na, nn)
-                if overlap_staging:
-                    # The round program is in flight; these transfers ride
-                    # under it. The barrier inside stage_round_data only
-                    # waits for the *transfer*, not the round.
-                    next_buffers = stage_round_data(ni, nm, mesh, spec)
+                next_buffers = stage_round_data(ni, nm, mesh, spec)
 
         # Round barrier: the metrics depend on every step of every client.
         metrics_host = jax.tree_util.tree_map(np.asarray, metrics)
         wall = time.perf_counter() - t0
 
         staging_s = 0.0
-        if next_host is not None and next_buffers is None:
-            ts = time.perf_counter()
-            next_buffers = stage_round_data(*next_host, mesh, spec)
-            staging_s = time.perf_counter() - ts
+        if not overlap_staging and r + 1 < n_rounds:
+            # Sequential mode: produce AND stage the next round's data after
+            # the barrier, so the recorded wall is a pure round time and the
+            # shuffle cost is paid (and accounted) outside it.
+            td = time.perf_counter()
+            nxt = data_fn(r + 1)
+            next_data_s = time.perf_counter() - td
+            if nxt is not None:
+                ni, nm, na, nn = nxt
+                next_host = (ni, nm)
+                next_cohort = (na, nn)
+                ts = time.perf_counter()
+                next_buffers = stage_round_data(ni, nm, mesh, spec)
+                staging_s = time.perf_counter() - ts
 
         record = RoundRecord(
             round_idx=r,

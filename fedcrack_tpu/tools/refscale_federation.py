@@ -1,28 +1,41 @@
 """The reference's COMPLETE federation at reference scale, on the mesh plane.
 
-The reference's actual run is 5 rounds (reference: fl_server.py:18) of
-10 local epochs x ~388 steps of batch 16 at 128 px over a 6,213-sample
-shard (client_fit_model.py:166,76,55-56). Round 3 benched ONE such round
-for timing only; this tool executes the WHOLE workload end to end through
-the production components and records the quality trajectory:
+The reference's actual run is N registered clients (cohort size is set by
+registrations, fl_server.py:59) federating for 5 rounds (fl_server.py:18):
+each round every client fits 10 local epochs x ~388 steps of batch 16 at
+128 px over its own 6,213-sample shard (client_fit_model.py:166,76,55-56),
+the server barriers over all N uploads (fl_server.py:116-117) and averages
+them (fl_server.py:92-102). Round 4 ran this with ONE mesh client — FedAvg
+over a single update is the identity, so that artifact was chunked
+centralized training (round-4 verdict, Missing #1). This tool runs the
+actual N-client federation on the one available chip:
 
-- one mesh client, the full round as one compiled XLA program
-  (``parallel.build_federated_round``);
-- a FIXED pool of 6,213 unique synthetic samples (not a cycled 512), freshly
-  reshuffled every round (the reference's keras Sequence reshuffles per fit);
-- uint8 transport staging, with the next round's reshuffled epoch
-  double-buffered under the in-flight round (``parallel.driver``);
-- BN-recalibrated held-out eval after every round (the server's eval path —
-  ``train.local.recalibrate_batch_stats`` + ``evaluate``), so the artifact
-  shows loss/IoU LEARNING across rounds, not just wall-clock.
+- ``--clients`` mesh clients (default 2), each with its OWN fixed pool of
+  ``--samples`` unique synthetic images (distinct seeds = distinct shards),
+  freshly reshuffled every round (the reference's keras Sequence reshuffles
+  per fit);
+- per round, each client's full local fit runs as one compiled XLA program
+  (``parallel.build_federated_round``) SERIALLY on the chip, every fit
+  starting from the same round-start global weights — time-multiplexing the
+  reference's concurrent clients onto one device;
+- non-degenerate sample-weighted FedAvg over the N divergent fits
+  (``fed.algorithms.fedavg``), with the per-client update norms and the
+  inter-client update distance recorded so the divergence being averaged is
+  visible in the artifact;
+- uint8 transport staging, double-buffered: the NEXT fit's reshuffled epoch
+  stages while the current fit's program is in flight (same overlap the
+  round driver uses, ``parallel.driver.stage_round_data``);
+- BN-recalibrated held-out eval of the aggregated global model after every
+  round (the server's eval path — ``train.local.recalibrate_batch_stats`` +
+  ``evaluate``), so the artifact shows loss/IoU LEARNING across rounds.
 
 Run on the TPU:
     python -m fedcrack_tpu.tools.refscale_federation \
-        --out bench_runs/r04_refscale_federation.json
+        --out bench_runs/r05_refscale_federation.json
 
 Scaled-down smoke (any host):
-    python -m fedcrack_tpu.tools.refscale_federation --rounds 2 --epochs 1 \
-        --samples 64 --img 32 --eval-samples 16 --out /tmp/smoke.json
+    python -m fedcrack_tpu.tools.refscale_federation --clients 2 --rounds 2 \
+        --epochs 1 --samples 64 --img 32 --eval-samples 16 --out /tmp/smoke.json
 """
 
 from __future__ import annotations
@@ -40,15 +53,31 @@ def _now() -> float:
     return time.perf_counter()
 
 
+def _params_l2_diff(a, b) -> float:
+    """||params_a - params_b||_2 computed on device, one scalar readback."""
+    import jax.numpy as jnp
+
+    sq = jax.tree_util.tree_map(
+        lambda x, y: jnp.sum(
+            (jnp.asarray(x, jnp.float32) - jnp.asarray(y, jnp.float32)) ** 2
+        ),
+        a["params"],
+        b["params"],
+    )
+    total = sum(jax.tree_util.tree_leaves(sq))
+    return float(np.sqrt(np.asarray(total)))
+
+
 def run_refscale_federation(args) -> dict:
     from fedcrack_tpu.configs import ModelConfig
-    from fedcrack_tpu.data.pipeline import ArrayDataset
+    from fedcrack_tpu.data.pipeline import ArrayDataset, to_uint8_transport
     from fedcrack_tpu.data.synthetic import synth_crack_batch
+    from fedcrack_tpu.fed.algorithms import fedavg
     from fedcrack_tpu.parallel import (
         build_federated_round,
         make_mesh,
-        run_mesh_federation,
         shuffled_epoch_data,
+        stage_round_data,
     )
     from fedcrack_tpu.train.local import (
         create_train_state,
@@ -60,17 +89,23 @@ def run_refscale_federation(args) -> dict:
     steps = args.samples // args.batch
     if steps < 1:
         raise SystemExit(f"--samples {args.samples} < --batch {args.batch}")
+    if args.clients < 1:
+        raise SystemExit(f"--clients {args.clients} < 1")
 
-    # The client's fixed local shard: args.samples UNIQUE images, uint8
-    # transport encoding (1/4 the staging bytes; on-device normalization is
-    # bit-exact vs float32 staging — data.pipeline.as_model_batch).
-    from fedcrack_tpu.data.pipeline import to_uint8_transport
-
+    # Each client's fixed local shard: args.samples UNIQUE images under a
+    # client-distinct seed, uint8 transport encoding (1/4 the staging bytes;
+    # on-device normalization is bit-exact vs float32 staging —
+    # data.pipeline.as_model_batch).
     t0 = _now()
-    pool_f, pool_masks_f = synth_crack_batch(args.samples, args.img, seed=args.seed)
-    pool_u8, pool_masks_u8 = to_uint8_transport(pool_f, pool_masks_f)
-    del pool_f
-    # Held-out eval set: distinct seed from the training shard.
+    pools = []
+    for c in range(args.clients):
+        pf, pm = synth_crack_batch(
+            args.samples, args.img, seed=args.seed + c * 104729
+        )
+        pu, pmu = to_uint8_transport(pf, pm)
+        del pf, pm
+        pools.append((pu, pmu))
+    # Held-out eval set: distinct seed from every training shard.
     ev_images, ev_masks = synth_crack_batch(
         args.eval_samples, args.img, seed=args.seed + 7919
     )
@@ -88,53 +123,128 @@ def run_refscale_federation(args) -> dict:
         pos_weight=args.pos_weight,
     )
     state_tmpl = create_train_state(jax.random.key(args.seed), config)
-    rng = np.random.default_rng(args.seed)
+    rngs = [
+        np.random.default_rng(args.seed + 31 * c) for c in range(args.clients)
+    ]
     active = np.ones(1, np.float32)
     n_samples = np.full(1, float(steps * args.batch), np.float32)
+    fit_weight = float(steps * args.batch)
 
-    def data_fn(r: int):
-        images, masks = shuffled_epoch_data(
-            pool_u8, pool_masks_u8, steps, args.batch, rng
+    def epoch_for(c: int):
+        return shuffled_epoch_data(
+            pools[c][0], pools[c][1], steps, args.batch, rngs[c]
         )
-        return images, masks, active, n_samples
 
+    # (round, client) fit schedule; one staged epoch always in flight ahead.
+    schedule = [(r, c) for r in range(args.rounds) for c in range(args.clients)]
+    t0 = _now()
+    imgs0, msks0 = epoch_for(0)
+    shuffle_s = _now() - t0
+    staged = stage_round_data(imgs0, msks0, mesh)
+    staged_bytes = int(imgs0.nbytes + msks0.nbytes)
+
+    global_vars = state_tmpl.variables
+    client_vars: list = []
+    fit_walls: list[float] = []
     rounds_out = []
+    round_t0 = _now()
+    round_fits: list[dict] = []
 
-    def on_round(record, variables):
-        # Server-side eval of the round's aggregated global model: BN
-        # recalibration then held-out metrics, at the training pos_weight.
-        t0 = _now()
-        host_vars = jax.device_get(variables)
-        st = state_tmpl.replace_variables(host_vars)
-        st = recalibrate_batch_stats(st, eval_ds, config)
-        m = evaluate(st, eval_ds, pos_weight=args.pos_weight)
-        eval_s = _now() - t0
+    session_t0 = _now()
+    for k, (r, c) in enumerate(schedule):
+        fit_t0 = _now()
+        new_vars, metrics = round_fn(global_vars, *staged, active, n_samples)
+
+        # Double buffer: the fit's program is in flight; the next fit's
+        # shuffle + staging transfers ride under it.
+        staged_next = None
+        next_shuffle_s = 0.0
+        next_bytes = 0
+        if k + 1 < len(schedule):
+            td = _now()
+            ni, nm = epoch_for(schedule[k + 1][1])
+            next_shuffle_s = _now() - td
+            staged_next = stage_round_data(ni, nm, mesh)
+            next_bytes = int(ni.nbytes + nm.nbytes)
+
+        # Fit barrier: the metrics depend on every step of the local fit.
         train = {
-            k: round(float(np.asarray(v)[0]), 4)
-            for k, v in record.metrics.items()
+            key: round(float(np.asarray(v)[0]), 4) for key, v in metrics.items()
         }
-        rounds_out.append(
+        fit_wall = _now() - fit_t0
+        fit_walls.append(fit_wall)
+        client_vars.append(new_vars)
+        round_fits.append(
             {
-                "round": record.round_idx + 1,
-                "wall_clock_s": round(record.wall_clock_s, 3),
-                "shuffle_s": round(record.data_fn_s, 3),
-                "staged_bytes": record.staged_bytes,
-                "overlapped_next_round_staging": record.overlapped,
+                "client": c,
+                "wall_clock_s": round(fit_wall, 3),
+                "shuffle_s": round(shuffle_s, 3),
+                "staged_bytes": staged_bytes,
+                "overlapped_next_fit_staging": staged_next is not None,
                 "train_last_epoch": train,
-                "eval": {k: round(float(v), 4) for k, v in m.items()},
-                "eval_s": round(eval_s, 2),
             }
         )
-        print(json.dumps(rounds_out[-1]), flush=True)
+        staged = staged_next
+        shuffle_s = next_shuffle_s
+        staged_bytes = next_bytes
 
-    t0 = _now()
-    _, records = run_mesh_federation(
-        round_fn, state_tmpl.variables, data_fn, args.rounds, mesh, on_round=on_round
-    )
-    session_s = _now() - t0
+        if c == args.clients - 1:
+            # Round boundary: sample-weighted FedAvg over the N divergent
+            # fits (fl_server.py:92-102 made non-degenerate), plus the
+            # divergence diagnostics that prove there was something to
+            # average.
+            agg_t0 = _now()
+            update_l2 = [
+                round(_params_l2_diff(cv, global_vars), 4) for cv in client_vars
+            ]
+            divergence_l2 = (
+                [
+                    round(_params_l2_diff(client_vars[i], client_vars[i + 1]), 4)
+                    for i in range(len(client_vars) - 1)
+                ]
+                if len(client_vars) > 1
+                else []
+            )
+            if len(client_vars) > 1:
+                new_global = fedavg(
+                    client_vars, weights=[fit_weight] * len(client_vars)
+                )
+            else:
+                new_global = client_vars[0]
+            jax.block_until_ready(jax.tree_util.tree_leaves(new_global)[0])
+            agg_s = _now() - agg_t0
+            global_vars = new_global
+            client_vars = []
 
-    walls = [r.wall_clock_s for r in records]
+            # Server-side eval of the aggregated global model: BN
+            # recalibration then held-out metrics, at the training pos_weight.
+            ev_t0 = _now()
+            host_vars = jax.device_get(global_vars)
+            st = state_tmpl.replace_variables(host_vars)
+            st = recalibrate_batch_stats(st, eval_ds, config)
+            m = evaluate(st, eval_ds, pos_weight=args.pos_weight)
+            eval_s = _now() - ev_t0
+
+            rounds_out.append(
+                {
+                    "round": r + 1,
+                    "wall_clock_s": round(_now() - round_t0 - eval_s, 3),
+                    "fits": round_fits,
+                    "aggregation_s": round(agg_s, 3),
+                    "update_l2": update_l2,
+                    "client_divergence_l2": divergence_l2,
+                    "eval": {key: round(float(v), 4) for key, v in m.items()},
+                    "eval_s": round(eval_s, 2),
+                }
+            )
+            print(json.dumps(rounds_out[-1]), flush=True)
+            round_fits = []
+            round_t0 = _now()
+    session_s = _now() - session_t0
+
+    walls = [r["wall_clock_s"] for r in rounds_out]
     post_compile = walls[1:] if len(walls) > 1 else walls
+    fit_post_compile = fit_walls[1:] if len(fit_walls) > 1 else fit_walls
     d = jax.devices()[0]
     ious = [r["eval"]["iou"] for r in rounds_out]
     losses = [r["eval"]["loss"] for r in rounds_out]
@@ -145,20 +255,23 @@ def run_refscale_federation(args) -> dict:
             "device_kind": getattr(d, "device_kind", "unknown"),
         },
         "workload": {
+            "clients": args.clients,
             "rounds": args.rounds,
             "local_epochs": args.epochs,
             "steps_per_epoch": steps,
             "batch": args.batch,
             "img_size": args.img,
-            "unique_samples": args.samples,
+            "unique_samples_per_client": args.samples,
             "compute_dtype": args.dtype,
             "pos_weight": args.pos_weight,
             "learning_rate": args.lr,
             "eval_samples": args.eval_samples,
             "reference_parity": (
-                "5 rounds (fl_server.py:18) x 10 epochs x 388 steps of "
-                "batch 16 at 128 px over 6213 samples "
-                "(client_fit_model.py:166,76,55-56)"
+                "N-client cohort + round barrier + average "
+                "(fl_server.py:59,116-117,92-102); 5 rounds (fl_server.py:18) "
+                "x 10 epochs x 388 steps of batch 16 at 128 px over 6213 "
+                "samples per client (client_fit_model.py:166,76,55-56); "
+                "clients time-multiplexed serially on one chip"
             ),
         },
         "rounds": rounds_out,
@@ -167,6 +280,9 @@ def run_refscale_federation(args) -> dict:
             "synthesis_s": round(synth_s, 2),
             "round_wall_clock_s_median_post_compile": round(
                 float(np.median(post_compile)), 3
+            ),
+            "fit_wall_clock_s_median_post_compile": round(
+                float(np.median(fit_post_compile)), 3
             ),
             "compile_round_s": round(walls[0], 2),
             "rounds_wall_clock_total_s": round(float(np.sum(walls)), 2),
@@ -180,9 +296,7 @@ def run_refscale_federation(args) -> dict:
             else round(float(np.sum(walls)), 2),
             "eval_iou_trajectory": ious,
             "eval_loss_trajectory": losses,
-            "learned": bool(
-                losses[-1] < losses[0] and ious[-1] > ious[0]
-            )
+            "learned": bool(losses[-1] < losses[0] and ious[-1] > ious[0])
             if len(rounds_out) >= 2
             else None,
         },
@@ -202,6 +316,7 @@ def main(argv=None) -> int:
         pass
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--out", required=True)
+    p.add_argument("--clients", type=int, default=2)
     p.add_argument("--rounds", type=int, default=5)
     p.add_argument("--epochs", type=int, default=10)
     p.add_argument("--samples", type=int, default=6213)
