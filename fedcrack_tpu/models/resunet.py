@@ -184,15 +184,27 @@ class ResUNet(nn.Module):
             residual = nn.Conv(features, (1, 1), name=f"dec{i}_res", **conv_kw)(
                 previous
             )
-            x = upsample2x(x + residual)
-            previous = x
+            x = x + residual
+            if i + 1 < len(cfg.decoder_features):
+                x = upsample2x(x)
+                previous = x
+            # else: the LAST block's upsample is deferred past the head below
+            # (same commute); `previous` is dead after the loop.
 
         # Per-pixel classification head; logits in float32 for a stable loss.
+        # The head's 1x1 conv also commutes with the final nearest-neighbor
+        # upsample (replicated pixels produce replicated dot products), so it
+        # runs at half resolution and the last upsample broadcasts ONE f32
+        # logit channel instead of `decoder_features[-1]` bf16 feature
+        # channels — at 256 px that upsample+head pair was ~12% of profiled
+        # device step time, nearly all HBM-bound (bench_runs/
+        # r05_profile_256.json: broadcast_in_dim 3.7% + its backward
+        # reduce_sum 2.5% + head fwd/bwd fusions 5.4%).
         logits = nn.Conv(
             cfg.num_classes, (1, 1), padding="SAME", kernel_init=_glorot,
             dtype=jnp.float32, param_dtype=pdtype, name="head",
         )(x.astype(jnp.float32))
-        return logits
+        return upsample2x(logits)
 
 
 def init_variables(rng: jax.Array, config: ModelConfig | None = None) -> dict:

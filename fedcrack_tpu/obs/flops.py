@@ -89,7 +89,9 @@ def resunet_forward_flops(config: ModelConfig | None = None, batch_size: int = 1
         s *= 2  # UpSampling2D(2)
         c = feat
 
-    total += _conv_flops(s, c, cfg.num_classes, 1)  # sigmoid head (s == img_size)
+    # The head's 1x1 conv is ALSO deferred past the final upsample (same
+    # commute, resunet.py): it executes at img_size/2, so count it there.
+    total += _conv_flops(s // 2, c, cfg.num_classes, 1)
     return total * float(batch_size)
 
 

@@ -257,12 +257,18 @@ def spatial_apply(
         # and one broadcast replaces two (also halves the halo shard's HBM
         # traffic here).
         residual = _conv1x1(previous, p[f"dec{i}_res"])
-        x = upsample2x(x + residual)
-        previous = x
+        x = x + residual
+        if i + 1 < len(cfg.decoder_features):
+            x = upsample2x(x)
+            previous = x
+        # else: final upsample deferred past the head, as in resunet.py.
 
-    logits = _conv1x1(x.astype(jnp.float32), jax.tree_util.tree_map(
+    # Head at half resolution, then upsample the single logit channel —
+    # the same head/upsample commute as models/resunet.py (upsampling is
+    # shard-local: it only replicates within rows this shard owns).
+    logits = upsample2x(_conv1x1(x.astype(jnp.float32), jax.tree_util.tree_map(
         lambda a: a.astype(jnp.float32), p["head"]
-    ))
+    )))
     if not train:
         return logits
     return logits, new_stats
