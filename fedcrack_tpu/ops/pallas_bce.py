@@ -19,8 +19,11 @@ single fused elementwise op that the compiler already emits optimally — a
 hand kernel would add nothing. The win is the fused multi-statistic forward
 reduction; ``jax.custom_vjp`` stitches the two together.
 
-Dispatch: ``impl=None`` auto-selects the kernel on TPU backends and the pure
-jnp reference elsewhere; tests force ``impl="pallas"`` under the Pallas
+Dispatch: ``impl=None`` selects the pure-XLA implementation everywhere —
+the on-chip A/B (bench_runs/r05_pallas_bce_ab.json; see ``default_impl``)
+measured the kernel at parity on the flagship shape and ~5% behind at
+256 px, so XLA's fusion is the default and ``FEDCRACK_BCE_IMPL=pallas``
+opts into the kernel; tests force ``impl="pallas"`` under the Pallas
 interpreter for numerics parity on CPU.
 """
 
@@ -192,15 +195,21 @@ bce_sums.defvjp(_bce_sums_fwd, _bce_sums_bwd)
 
 
 def default_impl() -> str:
-    """Kernel on TPU, XLA reference elsewhere (Pallas interpret mode is for
-    tests, not production CPU). ``FEDCRACK_BCE_IMPL`` overrides (escape hatch
-    for debugging kernel-vs-XLA differences in a full run)."""
+    """XLA everywhere: the interleaved on-chip A/B
+    (bench_runs/r05_pallas_bce_ab.json, v5e, slope-fit, variants alternated
+    within one process) measured the kernel as a WASH at the 128 px flagship
+    (0.99x) and ~5% SLOWER at 256 px — the pad/reshape to (rows, 128) lane
+    tiles is a materialization boundary that blocks XLA from fusing the
+    reductions into the ops producing the logits. Same honest-negative
+    outcome as the custom pool backward (BASELINE.md). The kernel stays as
+    the measured alternative: ``FEDCRACK_BCE_IMPL=pallas`` opts in, and
+    tests pin its numerics so the option cannot rot."""
     import os
 
     forced = os.environ.get("FEDCRACK_BCE_IMPL")
     if forced:
         return forced
-    return "pallas" if jax.default_backend() == "tpu" else "jnp"
+    return "jnp"
 
 
 def fused_segmentation_metrics(
