@@ -1200,13 +1200,22 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
         )
         if _fits(est):
             t0 = time.monotonic()
-            point, _ = _bench_reference_scale(
-                img, "bfloat16", device, ref_mesh, full=True
-            )
+            try:
+                point, _ = _bench_reference_scale(
+                    img, "bfloat16", device, ref_mesh, full=True
+                )
+            except Exception as e:
+                # Observed on this tunnel (round 5): the remote compile
+                # helper dies on the 256 px 3,880-step program (its 1.6 GB
+                # staged epoch exceeds the helper's capacity, remat or not —
+                # bench_runs/ isolation logs). Record the failure as a skip;
+                # every earlier section's data is already in the payload.
+                point = None
+                _skip(skips, f"ref_scale_bfloat16_{img}", est, f"failed: {e!r:.180}")
             section_s[f"ref_bf16_{img}"] = time.monotonic() - t0
             if point is not None:
                 detail.setdefault("reference_scale", {})[f"bfloat16_{img}"] = point
-            else:
+            elif not any(s["section"] == f"ref_scale_bfloat16_{img}" for s in skips):
                 _skip(skips, f"ref_scale_bfloat16_{img}", est, "budget ran out mid-point")
         else:
             _skip(skips, f"ref_scale_bfloat16_{img}", est, "estimate exceeds remaining budget")
