@@ -110,3 +110,43 @@ def test_jit_compiles_once_static_shapes(variables):
     assert fn._cache_size() == 1
     fn(variables, x + 1).block_until_ready()
     assert fn._cache_size() == 1
+
+
+def test_head_commutes_with_final_upsample(variables):
+    """The round-5 fusion invariant, pinned on the MODEL's actual op order:
+    the head must execute at HALF resolution (the deferral is real, not
+    just documented), the model output must be exactly the nearest-neighbor
+    upsample of that half-resolution head output, and the literal
+    Keras/reference order (head AFTER the upsample) must reproduce the same
+    logits bit-for-bit — replicated pixels produce replicated dot
+    products."""
+    config = ModelConfig(img_size=32)
+    model = ResUNet(config=config)
+    rng = jax.random.PRNGKey(3)
+    images = jax.random.uniform(rng, (2, 32, 32, 3), jnp.float32)
+    logits, state = model.apply(
+        variables,
+        images,
+        train=False,
+        capture_intermediates=True,
+        mutable=["intermediates"],
+    )
+    head_out = state["intermediates"]["head"]["__call__"][0]
+
+    # The deferral is in effect: head ran at half resolution, and the final
+    # model op is exactly one nearest-neighbor upsample of its output.
+    assert head_out.shape == (2, 16, 16, 1)
+    assert logits.shape == (2, 32, 32, 1)
+    assert jnp.array_equal(logits, upsample2x(head_out))
+
+    # Keras/reference order on the same weights: a hand-built 1x1 head
+    # applied AFTER upsampling commutes bit-exactly, so the deferred model
+    # and the literal op order agree for any feature map.
+    head_k = variables["params"]["head"]["kernel"].astype(jnp.float32)
+    head_b = variables["params"]["head"]["bias"].astype(jnp.float32)
+    f = jax.random.normal(jax.random.PRNGKey(4), (2, 16, 16, head_k.shape[2]))
+
+    def head(x):
+        return jnp.tensordot(x, head_k[0, 0], axes=[[3], [0]]) + head_b
+
+    assert jnp.array_equal(head(upsample2x(f)), upsample2x(head(f)))
