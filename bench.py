@@ -20,6 +20,13 @@ was an empty timeout. This bench is budget-aware:
 - **SIGTERM/SIGINT safety net**: if the driver kills the run anyway, the
   handler prints the partial JSON before exiting, so even a timeout captures
   every completed section.
+- **Exit-code contract** (changed in round 5; the round-3 docs said rc 0 on
+  TERM): an interrupted-but-emitted run exits **128+signum** (143 on TERM,
+  130 on INT) with the partial JSON already printed and its payload marked
+  ``interrupted: <SIGNAME>``. Drivers must treat 128+signum WITH a parsed
+  JSON line as "partial artifact", not "failed run" — rc 0 now means only a
+  run that completed inside its own budget. (The driver's own timeout
+  killing us with SIGKILL still yields rc 137 and whatever was flushed.)
 - Expensive measurements are shared: the f32 reference-scale point reuses
   the bf16 point's staged uint8 buffers (transport data is dtype-independent)
   and its staging timings; the sweep's long-scan arrays are tiled from the
@@ -49,6 +56,14 @@ Measurement design (unchanged from round 3, validated in bench_runs/):
 5. **Batch curve** (round 5): bf16 flagship per-step/MFU at batch {32, 64}
    from on-device regrouped sweep data — evidence for/against the
    width-bound MFU-ceiling claim (batch 16 stays the parity headline).
+6. **Layout A/B** (round 6): the model-graph layout transforms
+   (space-to-depth stem, channel-packed residual projections —
+   models/resunet.py, exact re-expressions of the same math) vs the
+   reference layout, interleaved over shared staged data at the flagship
+   size (bf16 + f32) and each secondary size (bf16), with MFU charged on
+   canonical reference-topology FLOPs for every variant. Variants via
+   FEDCRACK_BENCH_LAYOUTS; artifact schema matches tools/ab_pallas_bce
+   (per-variant dicts under "impls", ratios as sibling keys).
 
 Prints ONE JSON line: value = flagship one-program round wall-clock (ms) at
 reference scale when measured (sweep scale otherwise); vs_baseline =
@@ -59,7 +74,9 @@ FEDCRACK_BENCH_BUDGET_S=780 FEDCRACK_BENCH_STEPS=32 FEDCRACK_BENCH_BATCH=16
 FEDCRACK_BENCH_REPS=3 FEDCRACK_BENCH_SIZES=128,256 FEDCRACK_BENCH_FIT_FACTOR=4
 FEDCRACK_BENCH_REF_SCALE=auto|1|0 FEDCRACK_BENCH_REF_EPOCHS=10
 FEDCRACK_BENCH_REF_STEPS=388 FEDCRACK_BENCH_REF_256=1 (opt-in: the ~10 min
-bf16/256 reference-scale point) FEDCRACK_PEAK_TFLOPS=<override chip peak>.
+bf16/256 reference-scale point) FEDCRACK_PEAK_TFLOPS=<override chip peak>
+FEDCRACK_BENCH_LAYOUTS=reference,s2d,s2d_full,respack,s2d+respack (layout
+A/B variants; first is the ratio denominator).
 """
 
 from __future__ import annotations
@@ -110,6 +127,20 @@ COMPILE_EST_S = 60.0
 # Longer-round multiplier for the dispatch-correction fit; the two-point
 # slope needs the rounds to differ, so 2 is the floor.
 FIT_FACTOR = max(2, int(os.environ.get("FEDCRACK_BENCH_FIT_FACTOR", "4")))
+
+# Model-graph layout variants for the interleaved layout A/B (round 6):
+# "reference", "s2d" (bit-exact width-folded space-to-depth stem),
+# "s2d_full" (fully collapsed stride-1 stem, ~1 ulp), "respack" (channel-
+# packed encoder residual projections, bit-exact); combine with "+"
+# (e.g. "s2d+respack"). The first variant is the ratio denominator and
+# should stay "reference".
+LAYOUTS = tuple(
+    s.strip()
+    for s in os.environ.get(
+        "FEDCRACK_BENCH_LAYOUTS", "reference,s2d,s2d_full,respack,s2d+respack"
+    ).split(",")
+    if s.strip()
+)
 
 CLIENTS_AX, BATCH_AX = "clients", "batch"
 
@@ -505,6 +536,176 @@ def _batch_curve(
         del bi, bm, bi_long, bm_long
         if checkpoint is not None:
             checkpoint()
+
+
+def _layout_config(img: int, dtype: str, variant: str):
+    """ModelConfig for a layout-A/B variant token (see ``LAYOUTS``)."""
+    from fedcrack_tpu.configs import ModelConfig
+
+    kw: dict = {}
+    for tok in variant.split("+"):
+        if tok == "reference":
+            pass
+        elif tok in ("s2d", "s2d_full"):
+            kw["stem_layout"] = tok
+        elif tok == "respack":
+            kw["res_layout"] = "packed"
+        else:
+            raise ValueError(f"unknown layout variant token {tok!r}")
+    return ModelConfig(img_size=img, compute_dtype=dtype, **kw)
+
+
+def _layout_ab(
+    img: int,
+    mesh,
+    n_clients: int,
+    device,
+    peak,
+    si,
+    sm,
+    out: dict,
+    *,
+    dtype: str = "bfloat16",
+    round_s_hint: float,
+    skips: list,
+    checkpoint=None,
+):
+    """Interleaved A/B of the model-graph layout transforms at one crop size.
+
+    The transforms (ModelConfig.stem_layout / res_layout) are exact
+    re-expressions of the same math (models/resunet.py), so the ONLY honest
+    question is wall-clock — measured with the same discipline as the
+    round-5 Pallas-BCE A/B: every variant's round program is built in one
+    process over the SAME staged reference-layout data (the transforms pack
+    on device — what a flag flip costs in production), timed at two scan
+    lengths with the variants' reps INTERLEAVED (A,B,C,A,B,C,...) so tunnel
+    drift hits all variants equally, slope = per-step time. MFU is charged
+    on CANONICAL (reference-topology) FLOPs for every variant — the
+    zero-extended kernels' structural-zero MACs are not achievement
+    (obs/flops.py) — so the MFU column moves only when wall-clock does.
+
+    Variants are added value-first and budget-gated INDIVIDUALLY: when the
+    remaining budget cannot fund the next variant, it is recorded under
+    ``skipped`` and the section publishes what it measured (a 2-variant A/B
+    beats a skipped section). Artifact schema matches tools/ab_pallas_bce:
+    per-variant dicts under ``impls``, derived ratios as sibling keys.
+    """
+    from fedcrack_tpu.obs.flops import mfu, train_step_flops
+    from fedcrack_tpu.parallel import build_federated_round
+    from fedcrack_tpu.train.local import create_train_state
+
+    variant_est = (2 + REPS) * (1 + FIT_FACTOR) * max(round_s_hint, 1e-3) + 2 * COMPILE_EST_S
+    if not _fits(variant_est * 2):
+        # Not even a 2-variant comparison fits — record one skip and spend
+        # nothing (not even the long-scan tiling below).
+        _skip(
+            skips,
+            f"layout_ab_{dtype}_{img}",
+            variant_est * 2,
+            "estimate exceeds remaining budget",
+        )
+        return
+
+    si_long = _tile_steps(si, FIT_FACTOR, mesh)
+    sm_long = _tile_steps(sm, FIT_FACTOR, mesh)
+    active = np.ones(n_clients, np.float32)
+    n_samp = np.full(n_clients, float(STEPS * BATCH), np.float32)
+    n_samp_long = np.full(n_clients, float(FIT_FACTOR * STEPS * BATCH), np.float32)
+    # One initial state serves every variant: parameter trees are
+    # layout-invariant (the transforms derive kernels in-forward).
+    state0 = create_train_state(jax.random.key(SEED), _layout_config(img, dtype, "reference"))
+
+    # Per-variant build + warm, value-first, individually budget-gated. The
+    # FIRST variant is priced cold (COMPILE_EST_S is real through the
+    # tunnel); every later variant is priced off the first one's MEASURED
+    # build+warm cost — on a warm persistent cache that is seconds, so a
+    # second driver run funds the full variant set where the cold estimate
+    # alone would starve it (same self-correcting-estimate pattern as
+    # _est_stage_s/_est_synth_s).
+    runners: dict[str, tuple] = {}
+    measured_variant_s = None
+    for variant in LAYOUTS:
+        est = variant_est if measured_variant_s is None else measured_variant_s
+        if not _fits(est * (1 if runners else 2)):
+            # The first gate prices TWO variants: a single measured variant
+            # has no comparison and would waste its budget.
+            _skip(
+                skips,
+                f"layout_ab_{dtype}_{img}_{variant}",
+                est,
+                "estimate exceeds remaining budget",
+            )
+            continue
+        t0v = time.monotonic()
+        config = _layout_config(img, dtype, variant)
+        round_fn = build_federated_round(mesh, config, learning_rate=1e-3, local_epochs=1)
+        short = _make_round_runner(round_fn, state0.variables, si, sm, active, n_samp)
+        long = _make_round_runner(
+            round_fn, state0.variables, si_long, sm_long, active, n_samp_long
+        )
+        for r in (short, long):
+            r()  # compile (host-pytree signature)
+            r()  # committed-device-input signature the timed reps use
+        runners[variant] = (short, long)
+        # build+warm just executed 2 short + 2 long rounds (+ any compile);
+        # the interleaved phase adds REPS x (short + long) on top.
+        build_warm_s = time.monotonic() - t0v
+        measured_variant_s = build_warm_s * (1.0 + REPS / 2.0)
+
+    if len(runners) < 2:
+        for variant in runners:
+            _skip(
+                skips,
+                f"layout_ab_{dtype}_{img}",
+                variant_est,
+                "fewer than 2 variants funded; no comparison possible",
+            )
+        return
+
+    # Interleaved timed reps: one short pass over all variants, then one
+    # long pass, per rep — drift lands on every variant equally.
+    shorts: dict[str, list] = {v: [] for v in runners}
+    longs: dict[str, list] = {v: [] for v in runners}
+    for _ in range(REPS):
+        for v, (short, _long) in runners.items():
+            shorts[v].append(_median_time(short, 1))
+        for v, (_short, long) in runners.items():
+            longs[v].append(_median_time(long, 1))
+
+    flops = train_step_flops(_layout_config(img, dtype, "reference"), BATCH)
+    impls = {}
+    for v in runners:
+        short_s = float(np.median(shorts[v]))
+        long_s = float(np.median(longs[v]))
+        slope = (long_s - short_s) / ((FIT_FACTOR - 1) * STEPS)
+        fit_ok = slope > 0.0
+        util = mfu(slope, flops, device) if fit_ok and peak is not None else None
+        impls[v] = {
+            "round_s_short": short_s,
+            "round_s_long": long_s,
+            "per_step_ms": round(slope * 1e3, 4) if fit_ok else None,
+            "mfu": None if util is None else round(util, 4),
+        }
+    point = {
+        "impls": impls,
+        "flops_per_step_canonical": flops,
+        "note": (
+            "MFU charged on canonical (reference-layout) FLOPs for every "
+            "variant; staged data is the shared reference-layout arrays "
+            "(transforms pack on device — the production flag-flip cost)"
+        ),
+    }
+    ref = impls.get("reference", {})
+    if ref.get("per_step_ms"):
+        point["speedup_vs_reference"] = {
+            v: round(ref["per_step_ms"] / p["per_step_ms"], 4)
+            for v, p in impls.items()
+            if v != "reference" and p["per_step_ms"]
+        }
+    out[f"{dtype}_{img}"] = point
+    del si_long, sm_long
+    if checkpoint is not None:
+        checkpoint()
 
 
 def _measure_input_pipeline(img: int) -> dict | None:
@@ -997,6 +1198,38 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
         detail["budget"] = _budget_detail()
         _set_payload(metric_headline, value, vs_baseline, detail)
 
+    # ---- layout A/B (round 6): the VERDICT r5 top ask — space-to-depth /
+    # channel-packing graph transforms vs the reference layout, interleaved,
+    # at the flagship size in the headline dtypes. Runs right after the
+    # reference-scale headline (it is this round's deliverable) and before
+    # the host plane; per-variant budget gating degrades it gracefully ----
+    layout_ab: dict = {}
+
+    def _layout_checkpoint():
+        detail["layout_ab"] = layout_ab
+        detail["budget"] = _budget_detail()
+        _set_payload(metric_headline, value, vs_baseline, detail)
+
+    t0 = time.monotonic()
+    for ab_dtype in ("bfloat16", "float32"):
+        _layout_ab(
+            SIZES[0],
+            mesh,
+            n_clients,
+            device,
+            peak,
+            flag_si,
+            flag_sm,
+            layout_ab,
+            dtype=ab_dtype,
+            round_s_hint=sweep[f"{ab_dtype}_{SIZES[0]}"]["round_s_raw"],
+            skips=skips,
+            checkpoint=_layout_checkpoint,
+        )
+    if layout_ab:
+        section_s[f"layout_ab_{SIZES[0]}"] = time.monotonic() - t0
+        _layout_checkpoint()
+
     # ---- host plane (reference architecture) — AFTER the headline sections
     # (round-4 weak #1: it cost 240 s under a congested tunnel and starved
     # them); degrades to a 1-rep median, then to a recorded skip ----
@@ -1018,10 +1251,17 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
             break
     else:
         _skip(skips, "host_plane", host_est, "estimate exceeds remaining budget")
-        if "reconstructed host/gRPC-style" in metric_headline:
-            # The ref-scale metric text promises a host-plane ratio that now
-            # cannot be computed — annotate rather than mislabel (the same
-            # labeling-honesty class as the round-4 metric/value fix).
+        if (
+            "reconstructed host/gRPC-style" in metric_headline
+            or "reference-scale f32 ratio" in metric_headline
+        ):
+            # The metric text promises a host-plane ratio that now cannot be
+            # computed — annotate rather than mislabel (the same
+            # labeling-honesty class as the round-4 metric/value fix). BOTH
+            # promising variants are matched (ADVICE r5 #2): the full
+            # ref-scale string and the bf16-point-missing string, whose
+            # "vs_baseline is the reference-scale f32 ratio" clause would
+            # otherwise keep promising a ratio that stays None.
             metric_headline += (
                 " [host plane budget-skipped: vs_baseline unavailable this run]"
             )
@@ -1180,10 +1420,32 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
             _skip(skips, f"sweep_{img}", est, "estimate exceeds remaining budget")
             continue
         t0 = time.monotonic()
-        _sweep_size(img, mesh, n_clients, device, peak, sweep)
+        _, _, (sz_si, sz_sm) = _sweep_size(img, mesh, n_clients, device, peak, sweep)
         section_s[f"sweep_{img}"] = time.monotonic() - t0
         detail["budget"] = _budget_detail()
         _set_payload(metric_headline, value, vs_baseline, detail)
+        # Layout A/B at the secondary size (the 256 px point of the round-6
+        # deliverable), reusing this sweep's staged arrays — bf16 only (the
+        # MFU headline dtype); per-variant gating trims it under pressure.
+        t0 = time.monotonic()
+        _layout_ab(
+            img,
+            mesh,
+            n_clients,
+            device,
+            peak,
+            sz_si,
+            sz_sm,
+            layout_ab,
+            dtype="bfloat16",
+            round_s_hint=sweep[f"bfloat16_{img}"]["round_s_raw"],
+            skips=skips,
+            checkpoint=_layout_checkpoint,
+        )
+        if f"bfloat16_{img}" in layout_ab:
+            section_s[f"layout_ab_{img}"] = time.monotonic() - t0
+            _layout_checkpoint()
+        del sz_si, sz_sm
 
     # ---- opt-in: the ~10 min bf16/256 reference-scale point ----
     if run_ref and REF_256 and len(SIZES) > 1:

@@ -13,12 +13,16 @@ override — it works any time before first backend use.
 import os
 import sys
 
-import jax
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 # Hard override: the container profile exports JAX_PLATFORMS=axon (the real
-# TPU tunnel); the suite must run on the virtual CPU mesh regardless.
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+# TPU tunnel); the suite must run on the virtual CPU mesh regardless. The
+# helper handles every JAX version (jax_num_cpu_devices where it exists,
+# the XLA_FLAGS host-device flag — read at first backend use, still in the
+# future here — where it doesn't).
+from fedcrack_tpu.jaxcompat import ensure_cpu_devices
+
+ensure_cpu_devices(8)
 
 # Keep TF (used only by h5-importer parity tests) off any accelerator and quiet.
 os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
@@ -27,5 +31,3 @@ os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
 # compile on CPU; cache them across test runs.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
-
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))

@@ -33,6 +33,30 @@ class ModelConfig:
     # reference trains in float32 throughout.
     compute_dtype: str = "float32"
     param_dtype: str = "float32"
+    # Layout transforms (models/resunet.py): exact re-expressions of the same
+    # math targeting the HBM-bound narrow-channel convs (BASELINE.md "The MFU
+    # ceiling" / "layout levers"). Parameter shapes NEVER change — transformed
+    # kernels are derived in-forward from the reference weights, so h5
+    # imports, FedAvg, serialization and checkpoints are layout-blind.
+    #
+    # stem_layout:
+    #   "reference" — the reference's Conv(3x3, stride 2) on [N,H,W,3].
+    #   "s2d"       — space-to-depth input [N,H/2,W/2,4C]; the stem runs as a
+    #                 width-folded (3,2) conv on 2C channels, stride (2,1) —
+    #                 BIT-EXACT vs the reference layout (the fold preserves
+    #                 XLA's (kh,kw,c) contraction order; test-pinned).
+    #   "s2d_full"  — the fully folded stride-1 (2,2) conv on 4C channels.
+    #                 Mathematically identical (same multiplies + exact zero
+    #                 terms) but XLA reassociates the longer contraction, so
+    #                 agreement is ~1 ulp, not bitwise (documented in
+    #                 BASELINE.md; the A/B bench measures both).
+    # res_layout:
+    #   "reference" — encoder residual projections as strided 1x1 convs.
+    #   "packed"    — encoder residual 1x1 stride-2 convs re-expressed as
+    #                 stride-1 1x1 convs over the space-to-depth-packed block
+    #                 input (zero-extended kernel; bit-exact, test-pinned).
+    stem_layout: str = "reference"
+    res_layout: str = "reference"
 
     def __post_init__(self) -> None:
         # stem /2 + three pools /2 then four x2 upsamples: output comes back to
@@ -41,6 +65,16 @@ class ModelConfig:
         if self.img_size % 16 != 0 or self.img_size <= 0:
             raise ValueError(
                 f"img_size must be a positive multiple of 16, got {self.img_size}"
+            )
+        if self.stem_layout not in ("reference", "s2d", "s2d_full"):
+            raise ValueError(
+                "stem_layout must be one of 'reference', 's2d', 's2d_full'; "
+                f"got {self.stem_layout!r}"
+            )
+        if self.res_layout not in ("reference", "packed"):
+            raise ValueError(
+                "res_layout must be 'reference' or 'packed'; "
+                f"got {self.res_layout!r}"
             )
 
     @property

@@ -120,9 +120,31 @@ def to_uint8_transport(images: np.ndarray, masks: np.ndarray) -> tuple[np.ndarra
     return images_u8, masks.astype(np.uint8)
 
 
+def space_to_depth_images(images: np.ndarray) -> np.ndarray:
+    """Host-side space-to-depth packing for STAGING: ``[..., H, W, C] ->
+    [..., H/2, W/2, 4C]`` with the same block-position-major channel order as
+    ``models.resunet.space_to_depth`` (its device twin — the model accepts
+    either layout when a ``stem_layout`` transform is on, skipping the
+    on-device relayout for pre-packed arrays). Works on any leading batch
+    dims (``[B, ...]`` or the round layout ``[C, steps, B, ...]``) and any
+    dtype — uint8 transport bytes pack identically to float32 (pure data
+    movement). Masks are NEVER packed: the loss runs at full resolution.
+    """
+    *lead, h, w, c = images.shape
+    if h % 2 or w % 2:
+        raise ValueError(f"space_to_depth_images needs even H,W; got {(h, w)}")
+    x = images.reshape(*lead, h // 2, 2, w // 2, 2, c)
+    n = len(lead)
+    x = x.transpose(*range(n), n, n + 2, n + 1, n + 3, n + 4)
+    return np.ascontiguousarray(x.reshape(*lead, h // 2, w // 2, 4 * c))
+
+
 def as_model_batch(images, masks):
     """Normalize a transport batch (possibly uint8, see ``transport_dtype``)
     to the model contract: float32 [0,1] images, float32 {0,1} masks.
+    Images may be space-to-depth-packed (``space_to_depth_images``) when the
+    model runs a ``stem_layout`` transform — normalization is elementwise and
+    layout-blind, and the model accepts both layouts.
 
     Why uint8 transport exists: the decode path resizes in uint8 BEFORE the
     /255 normalization (exactly like the reference, client_fit_model.py:30-43),

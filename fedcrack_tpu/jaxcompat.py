@@ -1,0 +1,166 @@
+"""Version tolerance for the small set of JAX APIs that moved recently.
+
+The data plane targets current JAX (``jax.shard_map`` with varying-axes
+tracking), but CI images and tunnels pin older releases where the same
+machinery lives under ``jax.experimental.shard_map`` and the vma system
+(``lax.pcast``) does not exist yet. Rather than sprinkling try/excepts at
+every call site, the handful of moved names resolve here once:
+
+- :func:`shard_map` — ``jax.shard_map`` when present (0.5+), else the
+  experimental module's implementation (identical call signature for the
+  ``mesh``/``in_specs``/``out_specs`` keywords this repo uses). The
+  experimental path runs with ``check_rep=False``: its pre-vma replication
+  checker is conservative (there is no ``pcast`` to teach it that a scan
+  carry re-replicates), and every replicated out_spec this repo emits is
+  replicated by construction — psum/pmean over the relevant axis right
+  before the return (fedavg_mesh, spatial) — which current JAX's vma
+  checker verifies for real in CI.
+- :func:`pcast_varying` — ``lax.pcast(..., to="varying")`` when the vma
+  system exists; identity otherwise (pre-vma shard_map has no varying-axes
+  tracking, so there is nothing to promote and the scan carry is already
+  stable).
+- :func:`typeof_vma` / :func:`shape_dtype_struct` — the vma of an abstract
+  value (``jax.typeof``) and a ``ShapeDtypeStruct`` carrying one; both
+  degrade to vma-less behavior where the system doesn't exist.
+- :func:`is_distributed_initialized` — ``jax.distributed.is_initialized``
+  when present, else the 0.4.x ``global_state.client`` probe. Resolved
+  DYNAMICALLY so tests that monkeypatch ``jax.distributed.is_initialized``
+  (with ``raising=False``) are honored on every version.
+- :func:`ensure_cpu_devices` — best-effort "run on the virtual n-device CPU
+  host platform" on any JAX version (``jax_num_cpu_devices`` where it
+  exists, the ``XLA_FLAGS`` host-device-count flag where it doesn't),
+  tolerating already-initialized backends. The single home for an idiom
+  that conftest, ``__graft_entry__``, measure_baseline and the multihost
+  test workers previously each hand-rolled.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+from typing import Any, Sequence
+
+import jax
+from jax import lax
+
+_raw_shard_map = getattr(jax, "shard_map", None)
+if _raw_shard_map is None:  # pragma: no cover - exercised on older JAX images
+    from jax.experimental.shard_map import shard_map as _raw_shard_map
+
+try:
+    _PRE_VMA_SHARD_MAP = "check_rep" in inspect.signature(_raw_shard_map).parameters
+except (TypeError, ValueError):  # pragma: no cover - unsignaturable builtin
+    _PRE_VMA_SHARD_MAP = not hasattr(lax, "pcast")
+
+if _PRE_VMA_SHARD_MAP:  # pragma: no cover - exercised on older JAX images
+
+    def shard_map(f, **kwargs):
+        # check_vma is the current-JAX spelling; pre-vma shard_map (whether
+        # importable as jax.shard_map or only from jax.experimental) calls
+        # the weaker analog check_rep — and it must default OFF here: its
+        # conservative checker has no pcast to learn that a scan carry
+        # re-replicates, and check_rep=True would ALSO flip the AD
+        # psum-insertion behavior out from under psum_if_no_auto below.
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        else:
+            kwargs.setdefault("check_rep", False)
+        return _raw_shard_map(f, **kwargs)
+
+else:
+    shard_map = _raw_shard_map
+
+
+# Does jax.grad INSIDE shard_map auto-insert the psum that keeps the
+# gradient of an axis-unvarying input consistent across shards? Under the
+# vma system it does; under a pre-vma shard_map run with check_rep=False
+# (how the wrapper above always runs it) the cotangent stays shard-LOCAL,
+# and every in-mesh gradient step must insert the psum itself (fedavg_mesh,
+# spatial) or silently train on 1/n-weighted shard-local gradients whenever
+# an inner data-parallel axis is wider than one shard. Keyed on the SAME
+# probe as the wrapper so the two decisions can never disagree (a JAX
+# window with public jax.shard_map but no vma system gets the wrapper AND
+# the explicit psum together).
+AD_PSUMS_UNVARYING_COTANGENTS = not _PRE_VMA_SHARD_MAP
+
+
+def psum_if_no_auto(tree: Any, axes: Sequence[str]) -> Any:
+    """Explicit replacement for the vma AD psum on pre-vma JAX: psum the
+    gradient tree over ``axes``; identity where AD already did it."""
+    if AD_PSUMS_UNVARYING_COTANGENTS or not axes:
+        return tree
+    return lax.psum(tree, tuple(axes))
+
+
+def pcast_varying(x: Any, axes: Sequence[str]) -> Any:
+    """Promote ``x`` to varying over ``axes`` where vma tracking exists;
+    no-op on pre-vma JAX."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, tuple(axes), to="varying")
+    return x
+
+
+def typeof_vma(x: Any) -> frozenset:
+    """The varying-manual-axes set of ``x``'s abstract value; empty where
+    the vma system (``jax.typeof``) doesn't exist."""
+    if hasattr(jax, "typeof"):
+        return getattr(jax.typeof(x), "vma", frozenset())
+    return frozenset()
+
+
+def shape_dtype_struct(shape, dtype, vma: frozenset = frozenset()):
+    """``jax.ShapeDtypeStruct`` carrying ``vma`` where supported (required
+    for pallas_call outputs under check_vma shard_map); plain struct
+    otherwise."""
+    if vma and hasattr(jax, "typeof"):
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        except TypeError:  # pragma: no cover - vma kwarg not accepted
+            pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def ensure_cpu_devices(n: int | None = None) -> None:
+    """Best-effort: route this process onto the CPU host platform with ``n``
+    virtual devices (``n=None`` leaves the device count alone).
+
+    Must run before first backend use to take effect; once backends are
+    initialized the config updates raise RuntimeError and this becomes a
+    no-op (callers that need a hard guarantee should check
+    ``len(jax.devices())`` afterwards — which itself initializes the
+    backend, so only do that LAST). On JAX without ``jax_num_cpu_devices``
+    the count rides the ``XLA_FLAGS`` host-device flag, which XLA reads at
+    backend initialization — still in the future at that point, or the
+    config update would have raised RuntimeError instead of AttributeError.
+    """
+    try:
+        if n is not None:
+            # Count first: it is the update that raises RuntimeError once
+            # backends are initialized, leaving jax_platforms untouched.
+            jax.config.update("jax_num_cpu_devices", n)
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backends already initialized; run where we are
+    except AttributeError:
+        if "--xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""
+        ):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass
+
+
+def is_distributed_initialized() -> bool:
+    """Whether this process runs inside an initialized jax.distributed job.
+    Reads ``jax.distributed.is_initialized`` dynamically (monkeypatchable);
+    falls back to the 0.4.x ``global_state.client`` probe."""
+    fn = getattr(jax.distributed, "is_initialized", None)
+    if fn is not None:
+        return bool(fn())
+    state = getattr(jax.distributed, "global_state", None)  # pragma: no cover
+    return getattr(state, "client", None) is not None  # pragma: no cover

@@ -10,7 +10,7 @@ client's handshake still resolves to the real model.
 from __future__ import annotations
 
 from fedcrack_tpu.configs import ModelConfig
-from fedcrack_tpu.models.resunet import ResUNet
+from fedcrack_tpu.models.resunet import ResUNet, depth_to_space, space_to_depth
 
 _ALIASES = {
     "resunet": "resunet",
@@ -28,4 +28,4 @@ def get_model(name: str = "resunet", config: ModelConfig | None = None) -> ResUN
     return ResUNet(config=config or ModelConfig())
 
 
-__all__ = ["ResUNet", "get_model"]
+__all__ = ["ResUNet", "depth_to_space", "get_model", "space_to_depth"]

@@ -20,6 +20,27 @@ tensor-for-tensor (SURVEY.md §7 "hard parts").
 Spatial bookkeeping: stem /2 and three pools /2 take 128x128 -> 8x8 at the
 bottleneck; four x2 upsampling stages return to 128x128, matching the
 full-resolution masks (SURVEY.md §2.3).
+
+Layout transforms (``ModelConfig.stem_layout`` / ``res_layout``): exact
+re-expressions of the same math targeting the HBM-bound narrow-channel convs
+(BASELINE.md "The MFU ceiling"). Parameter shapes NEVER change — the
+transformed kernels are derived in-forward from the reference weights
+(``fold_stem_kernel_s2d`` and friends; the derivation is linear, so
+gradients flow back to the reference parameterization and training is the
+same program family either way), which keeps h5 imports/exports, FedAvg,
+the wire format and checkpoints layout-blind.
+
+Why "s2d" is a width fold and not the fully collapsed stride-1 conv: XLA
+contracts a conv's reduction dimensions in (kh, kw, c) order, and a layout
+transform is bit-exact iff it preserves the relative order of the NONZERO
+terms (inserting exact zero taps anywhere is a no-op; reordering real taps
+reassociates the float sum). Folding W into channels keeps that order
+(per kh: kw-major, zeros appended); folding H too would need tap (0,2) to
+land between (0,1) and (1,0), but (0,1)/(1,0) share a 2x2 block while (0,2)
+does not — impossible for any channel permutation. The fully folded variant
+is still offered as ``stem_layout="s2d_full"`` for the A/B bench, with its
+~1-ulp reassociation documented rather than hidden (measured in
+tests/test_model.py; BASELINE.md "layout levers").
 """
 
 from __future__ import annotations
@@ -45,6 +66,122 @@ _BN_MOMENTUM = 0.99
 _BN_EPSILON = 1e-3
 
 _glorot = nn.initializers.glorot_uniform()
+
+
+def space_to_depth(x: jax.Array) -> jax.Array:
+    """``[N,H,W,C] -> [N,H/2,W/2,4C]``: 2x2 pixel blocks to channels,
+    block-position-major (packed channel = ``(di*2+dj)*C + c`` for the pixel
+    at block offset ``(di, dj)``). Pure data movement — the canonical packed
+    input layout for ``stem_layout="s2d"``/``"s2d_full"``; the host-side
+    twin for staging is ``data.pipeline.space_to_depth_images``."""
+    n, h, w, c = x.shape
+    if h % 2 or w % 2:
+        raise ValueError(f"space_to_depth needs even H,W; got {(h, w)}")
+    x = x.reshape(n, h // 2, 2, w // 2, 2, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // 2, w // 2, 4 * c)
+
+
+def depth_to_space(x: jax.Array) -> jax.Array:
+    """Inverse of :func:`space_to_depth`."""
+    n, h2, w2, c4 = x.shape
+    if c4 % 4:
+        raise ValueError(f"depth_to_space needs channels % 4 == 0; got {c4}")
+    c = c4 // 4
+    x = x.reshape(n, h2, w2, 2, 2, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, 2 * h2, 2 * w2, c)
+
+
+def fold_stem_kernel_s2d(kernel: jax.Array) -> jax.Array:
+    """Reference stem kernel ``[3,3,C,F]`` -> width-folded ``[3,2,2C,F]``.
+
+    Tap ``(kh, kw, c)`` lands at ``[kh, kw//2, (kw%2)*C + c]``; the unused
+    slot ``(kh, bw=1, dj=1)`` is exact zero. Preserves XLA's (kh, kw, c)
+    contraction order, so the folded conv (strides (2,1), padding
+    ((0,1),(0,1)) on the width-packed input) is BIT-EXACT vs the reference
+    stem. Linear in ``kernel`` — differentiable, gradients flow back to the
+    reference parameterization."""
+    if kernel.shape[:2] != (3, 3):
+        raise ValueError(f"expected a 3x3 stem kernel, got {kernel.shape}")
+    k0 = jnp.concatenate([kernel[:, 0], kernel[:, 1]], axis=1)  # [3, 2C, F]
+    k1 = jnp.concatenate([kernel[:, 2], jnp.zeros_like(kernel[:, 2])], axis=1)
+    return jnp.stack([k0, k1], axis=1)  # [3, 2, 2C, F]
+
+
+def unfold_stem_kernel_s2d(folded: jax.Array) -> jax.Array:
+    """Exact inverse of :func:`fold_stem_kernel_s2d` (weight export for a
+    kernel held in the folded layout)."""
+    if folded.shape[:2] != (3, 2):
+        raise ValueError(f"expected a [3,2,2C,F] folded kernel, got {folded.shape}")
+    c = folded.shape[2] // 2
+    k0, k1 = folded[:, 0], folded[:, 1]
+    return jnp.stack([k0[:, :c], k0[:, c:], k1[:, :c]], axis=1)
+
+
+def fold_stem_kernel_s2d_full(kernel: jax.Array) -> jax.Array:
+    """Reference stem kernel ``[3,3,C,F]`` -> fully folded ``[2,2,4C,F]``
+    for the stride-1 conv on the space-to-depth input.
+
+    Tap ``(kh, kw, c)`` lands at ``[kh//2, kw//2, ((kh%2)*2 + kw%2)*C + c]``;
+    the 2x2 block structure forces taps of different kh rows into one packed
+    block, which REORDERS the contraction — mathematically identical (same
+    multiplies plus exact zeros) but reassociated, so agreement with the
+    reference stem is ~1 ulp rather than bitwise (module docstring)."""
+    if kernel.shape[:2] != (3, 3):
+        raise ValueError(f"expected a 3x3 stem kernel, got {kernel.shape}")
+    zeros = jnp.zeros_like(kernel[0, 0])  # [C, F]
+
+    def tap(kh: int, kw: int) -> jax.Array:
+        return kernel[kh, kw] if kh < 3 and kw < 3 else zeros
+
+    rows = []
+    for bh in range(2):
+        row = [
+            jnp.concatenate(
+                [tap(2 * bh + di, 2 * bw + dj) for di in (0, 1) for dj in (0, 1)],
+                axis=0,
+            )
+            for bw in range(2)
+        ]
+        rows.append(jnp.stack(row, axis=0))
+    return jnp.stack(rows, axis=0)  # [2, 2, 4C, F]
+
+
+def unfold_stem_kernel_s2d_full(folded: jax.Array) -> jax.Array:
+    """Exact inverse of :func:`fold_stem_kernel_s2d_full`."""
+    if folded.shape[:2] != (2, 2):
+        raise ValueError(f"expected a [2,2,4C,F] folded kernel, got {folded.shape}")
+    c = folded.shape[2] // 4
+    taps = []
+    for kh in range(3):
+        row = []
+        for kw in range(3):
+            lo = ((kh % 2) * 2 + kw % 2) * c
+            row.append(folded[kh // 2, kw // 2, lo : lo + c])
+        taps.append(jnp.stack(row, axis=0))
+    return jnp.stack(taps, axis=0)
+
+
+def pack_res_kernel(kernel: jax.Array) -> jax.Array:
+    """Reference 1x1 residual kernel ``[1,1,C,F]`` -> ``[1,1,4C,F]`` for the
+    stride-1 conv on the space-to-depth-packed block input: the real taps
+    (block offset (0,0) — exactly the pixels a stride-2 1x1 conv reads) stay
+    FIRST, zero-extension follows, so the contraction order of the nonzero
+    terms is preserved and the packed projection is bit-exact."""
+    if kernel.shape[:2] != (1, 1):
+        raise ValueError(f"expected a 1x1 residual kernel, got {kernel.shape}")
+    zeros = jnp.zeros(
+        (1, 1, 3 * kernel.shape[2], kernel.shape[3]), dtype=kernel.dtype
+    )
+    return jnp.concatenate([kernel, zeros], axis=2)
+
+
+def unpack_res_kernel(packed: jax.Array) -> jax.Array:
+    """Exact inverse of :func:`pack_res_kernel`."""
+    if packed.shape[2] % 4:
+        raise ValueError(f"expected a [1,1,4C,F] packed kernel, got {packed.shape}")
+    return packed[:, :, : packed.shape[2] // 4]
 
 
 def upsample2x(x: jax.Array) -> jax.Array:
@@ -97,6 +234,99 @@ class SeparableConv(nn.Module):
         return x
 
 
+class S2DStemConv(nn.Module):
+    """The stem conv executed in a space-to-depth layout.
+
+    Declares the SAME parameters as the reference ``nn.Conv`` stem — kernel
+    ``[3,3,C,F]`` (glorot) and bias ``[F]`` (zeros) under the same module
+    name — so the variables pytree, its initialization values (same RNG
+    fold), h5 import/export and FedAvg are all identical to the reference
+    layout; only the executed program changes. Accepts the reference input
+    ``[N,H,W,C]`` (packed on device: the width fold is a FREE row-major
+    reshape) or the pre-packed ``[N,H/2,W/2,4C]`` of :func:`space_to_depth`
+    (staged that way by ``parallel.driver``-style loops).
+
+    ``layout="s2d"``: width-folded ``[3,2,2C,F]`` kernel, strides (2,1) —
+    bit-exact (contraction-order-preserving, see module docstring).
+    ``layout="s2d_full"``: fully folded ``[2,2,4C,F]`` kernel, stride 1 —
+    mathematically identical, reassociated (~1 ulp).
+    """
+
+    features: int
+    in_channels: int
+    layout: str  # "s2d" | "s2d_full"
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c = self.in_channels
+        kernel = self.param("kernel", _glorot, (3, 3, c, self.features), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros_init(), (self.features,), self.param_dtype)
+        kernel = kernel.astype(self.dtype)
+        bias = bias.astype(self.dtype)
+
+        packed = x.shape[-1] == 4 * c
+        if not packed and x.shape[-1] != c:
+            raise ValueError(
+                f"stem input has {x.shape[-1]} channels; expected {c} "
+                f"(reference layout) or {4 * c} (space_to_depth-packed)"
+            )
+        n = x.shape[0]
+        if self.layout == "s2d":
+            if packed:
+                h2, w2 = x.shape[1], x.shape[2]
+                # Unpack H only: [N,H/2,W/2,4C] -> [N,H,W/2,2C] (data movement).
+                x = x.reshape(n, h2, w2, 2, 2, c)
+                x = x.transpose(0, 1, 3, 2, 4, 5).reshape(n, 2 * h2, w2, 2 * c)
+            else:
+                h, w = x.shape[1], x.shape[2]
+                # Width fold is a pure row-major reshape — no copy.
+                x = x.reshape(n, h, w // 2, 2 * c)
+            folded = fold_stem_kernel_s2d(kernel)
+            strides = (2, 1)
+        else:  # "s2d_full"
+            if not packed:
+                x = space_to_depth(x)
+            folded = fold_stem_kernel_s2d_full(kernel)
+            strides = (1, 1)
+        y = jax.lax.conv_general_dilated(
+            x,
+            folded,
+            window_strides=strides,
+            padding=[(0, 1), (0, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        return y + bias
+
+
+class PackedResConv(nn.Module):
+    """An encoder residual projection — reference ``Conv(F, 1x1, stride 2)``
+    — executed as a stride-1 1x1 conv over the space-to-depth-packed block
+    input with a zero-extended ``[1,1,4C,F]`` kernel (bit-exact: the packed
+    block offset (0,0) channels are exactly the pixels the strided conv
+    reads, and they stay first in the contraction). Parameters are identical
+    to the reference ``nn.Conv`` (kernel ``[1,1,C,F]`` glorot + bias)."""
+
+    features: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c = x.shape[-1]
+        kernel = self.param("kernel", _glorot, (1, 1, c, self.features), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros_init(), (self.features,), self.param_dtype)
+        y = jax.lax.conv_general_dilated(
+            space_to_depth(x),
+            pack_res_kernel(kernel.astype(self.dtype)),
+            window_strides=(1, 1),
+            padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        return y + bias.astype(self.dtype)
+
+
 class ResUNet(nn.Module):
     """The crack-segmentation residual U-Net. Returns per-pixel logits.
 
@@ -134,8 +364,22 @@ class ResUNet(nn.Module):
 
         x = x.astype(dtype)
 
-        # Entry block (stem): /2.
-        x = nn.Conv(cfg.stem_features, (3, 3), strides=(2, 2), name="stem_conv", **conv_kw)(x)
+        # Entry block (stem): /2. Under a space-to-depth layout the stem
+        # consumes either the reference [N,H,W,C] input or the packed
+        # [N,H/2,W/2,4C] of `space_to_depth` and runs a folded kernel derived
+        # in-forward from the SAME parameters (S2DStemConv); everything from
+        # stem_bn on is layout-independent.
+        if cfg.stem_layout == "reference":
+            x = nn.Conv(cfg.stem_features, (3, 3), strides=(2, 2), name="stem_conv", **conv_kw)(x)
+        else:
+            x = S2DStemConv(
+                cfg.stem_features,
+                in_channels=cfg.in_channels,
+                layout=cfg.stem_layout,
+                dtype=dtype,
+                param_dtype=pdtype,
+                name="stem_conv",
+            )(x)
         x = bn("stem_bn")(x)
         x = nn.relu(x)
         previous = x  # residual carried across blocks
@@ -155,9 +399,15 @@ class ResUNet(nn.Module):
                 x = max_pool_auto(x)
             else:
                 x = nn.max_pool(x, window_shape=(3, 3), strides=(2, 2), padding="SAME")
-            residual = nn.Conv(
-                features, (1, 1), strides=(2, 2), name=f"enc{i}_res", **conv_kw
-            )(previous)
+            if cfg.res_layout == "packed":
+                # Strided 1x1 conv re-expressed channel-packed (bit-exact).
+                residual = PackedResConv(
+                    features, dtype=dtype, param_dtype=pdtype, name=f"enc{i}_res"
+                )(previous)
+            else:
+                residual = nn.Conv(
+                    features, (1, 1), strides=(2, 2), name=f"enc{i}_res", **conv_kw
+                )(previous)
             x = x + residual
             previous = x
 

@@ -12,6 +12,16 @@ HLO cost analysis in tests/test_flops.py.
 MFU is reported against the chip's **bf16 MXU peak** for both dtypes (the
 standard convention — float32 runs the same systolic array via multi-pass,
 so "fraction of the machine's ceiling" stays comparable across dtypes).
+
+CANONICAL FLOPs, by design: this model deliberately ignores
+``ModelConfig.stem_layout`` / ``res_layout``. The layout transforms
+(models/resunet.py) re-express the same math with zero-extended kernels —
+e.g. the packed residual projection nominally multiplies 4x the input
+channels, 3/4 of them structural zeros — and counting those zero MACs
+would inflate "achieved FLOP/s" for the transformed variants. Every
+layout is charged the REFERENCE topology's FLOPs, so an A/B's MFU column
+moves only when wall-clock does (the honesty requirement of bench.py's
+layout A/B; pinned by tests/test_flops.py).
 """
 
 from __future__ import annotations
@@ -60,6 +70,10 @@ def resunet_forward_flops(config: ModelConfig | None = None, batch_size: int = 1
     (depthwise 3x3 + pointwise 1x1) x2 + pool /2 + strided 1x1 residual;
     decoder blocks (3x3 transpose-conv, stride 1 == plain conv) x2 +
     low-resolution 1x1 residual + single upsample x2; 1x1 head.
+
+    Layout flags (stem_layout/res_layout) are intentionally NOT consulted:
+    transformed variants are charged the same canonical FLOPs (module
+    docstring).
     """
     cfg = config or ModelConfig()
     s = cfg.img_size // 2  # after the stride-2 stem

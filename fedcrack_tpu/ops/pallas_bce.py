@@ -112,10 +112,11 @@ def _sums_pallas(x: jax.Array, y: jax.Array, interpret: bool) -> jax.Array:
 
     spec_kw = {} if _VMEM is None else {"memory_space": _VMEM}
     # Under shard_map the output varies over the same mesh axes as the inputs
-    # (per-device statistics); propagate the vma so check_vma stays on.
-    vma = getattr(jax.typeof(xp), "vma", frozenset()) | getattr(
-        jax.typeof(yp), "vma", frozenset()
-    )
+    # (per-device statistics); propagate the vma so check_vma stays on
+    # (no-op on pre-vma JAX — jaxcompat).
+    from fedcrack_tpu.jaxcompat import shape_dtype_struct, typeof_vma
+
+    vma = typeof_vma(xp) | typeof_vma(yp)
     out = pl.pallas_call(
         functools.partial(_fwd_kernel, n_valid=n, block_rows=BLOCK_ROWS),
         grid=(rows_pad // BLOCK_ROWS,),
@@ -124,7 +125,7 @@ def _sums_pallas(x: jax.Array, y: jax.Array, interpret: bool) -> jax.Array:
             pl.BlockSpec((BLOCK_ROWS, LANE), lambda i: (i, 0), **spec_kw),
         ],
         out_specs=pl.BlockSpec((8, LANE), lambda i: (0, 0), **spec_kw),
-        out_shape=jax.ShapeDtypeStruct((8, LANE), jnp.float32, vma=vma),
+        out_shape=shape_dtype_struct((8, LANE), jnp.float32, vma=vma),
         interpret=interpret,
     )(xp, yp)
     return out[0, :5]

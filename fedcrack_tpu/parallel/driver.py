@@ -33,7 +33,17 @@ CLIENTS, BATCH = "clients", "batch"
 
 @dataclasses.dataclass
 class RoundRecord:
-    """One round's timing + metrics, host-side."""
+    """One round's timing + metrics, host-side.
+
+    COMPARABILITY NOTE (round 5+): in sequential mode
+    (``overlap_staging=False``) the ``data_fn(r+1)`` host shuffle is ALSO
+    deferred past the round barrier (previously only staging was serialized
+    while the shuffle rode under the in-flight round). Sequential session
+    totals (``sum(wall_clock_s + data_fn_s + staging_s)``) therefore now
+    include the unoverlapped shuffle and are NOT comparable to pre-round-5
+    sequential runs; per-round ``wall_clock_s`` is the intended pure round
+    time either way. Overlap-mode records are unaffected.
+    """
 
     round_idx: int
     metrics: dict[str, np.ndarray]  # per-client leaves from the round program
@@ -42,7 +52,8 @@ class RoundRecord:
     # EMBEDDED in this wall — summing wall_clock_s + data_fn_s across records
     # double-counts data_fn. Sum wall_clock_s alone for session time. In
     # sequential mode (overlap_staging=False) data_fn/staging run after the
-    # round barrier, so wall_clock_s is a pure round time.
+    # round barrier, so wall_clock_s is a pure round time (and the session
+    # total picks up the shuffle separately — see the class docstring).
     wall_clock_s: float
     data_fn_s: float  # host time data_fn spent producing THIS round's data
     staging_s: float  # sequential-mode next-round staging (0 when overlapped)
@@ -64,7 +75,15 @@ def stage_round_data(
     image_spec: P | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Put one round's ``[C, steps, B, ...]`` arrays on the mesh and barrier
-    until the bytes have landed."""
+    until the bytes have landed.
+
+    Staging shapes are layout-agnostic: under a transformed model layout
+    (``ModelConfig.stem_layout``) ``images`` may be pre-packed to
+    ``[C, steps, B, H/2, W/2, 4*ch]`` (``data.pipeline.space_to_depth_images``
+    — identical byte count, so transfer estimates and ``staged_bytes``
+    accounting are unchanged); the default ``P(clients, None, batch)`` spec
+    shards the same leading axes either way. Masks always stage
+    full-resolution."""
     sharding = NamedSharding(mesh, image_spec if image_spec is not None else P(CLIENTS, None, BATCH))
     si = jax.device_put(images, sharding)
     sm = jax.device_put(masks, sharding)

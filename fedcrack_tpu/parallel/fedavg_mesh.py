@@ -35,6 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from fedcrack_tpu.configs import ModelConfig
 from fedcrack_tpu.data.pipeline import as_model_batch
 from fedcrack_tpu.fed.algorithms import fedprox_penalty
+from fedcrack_tpu.jaxcompat import pcast_varying, psum_if_no_auto, shard_map
 from fedcrack_tpu.models import ResUNet
 from fedcrack_tpu.ops.losses import iou_from_counts
 from fedcrack_tpu.ops.pallas_bce import fused_segmentation_metrics
@@ -147,6 +148,8 @@ def _build_round(
             # count turns that sum of local-mean gradients into the gradient
             # of the client's full mean loss (a pmean here would be an
             # identity on the already-summed value and double-count).
+            # Pre-vma JAX performs NO such AD psum — jaxcompat inserts the
+            # equivalent explicit one there (identity on current JAX).
             # CAUTION: that AD-inserted psum spans ONLY the inner axis — not
             # the clients axis — solely because the lax.scan carry makes
             # params clients-VARYING after step one (carry-vma unification
@@ -156,6 +159,7 @@ def _build_round(
             # reason). If this round is ever restructured without the scan,
             # the divisor must change; test_dp_gradient_not_double_counted
             # pins the current behavior.
+            grads = psum_if_no_auto(grads, (inner_axis,))
             grads = jax.tree_util.tree_map(lambda g: g / n_inner, grads)
             # BN moments are already pmean-synced inside the forward; this
             # keeps the carried stats bitwise identical across inner shards.
@@ -184,7 +188,7 @@ def _build_round(
         # update; promote the (replicated) initial carry so scan's carry type
         # is stable under shard_map's varying-axes tracking.
         carry = jax.tree_util.tree_map(
-            lambda x: lax.pcast(x, (CLIENTS,), to="varying"),
+            lambda x: pcast_varying(x, (CLIENTS,)),
             (params, batch_stats, opt_state),
         )
         carry, per_epoch = lax.scan(
@@ -223,7 +227,7 @@ def _build_round(
         metrics = jax.tree_util.tree_map(lambda a: a[None], metrics)
         return new_variables, metrics
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         client_fit,
         mesh=mesh,
         in_specs=(P(), image_spec, image_spec, P(CLIENTS), P(CLIENTS)),
@@ -287,10 +291,29 @@ def build_federated_round(
     ``[C]`` arrays from each client's final local epoch. Adam state is fresh
     each round (the reference rebuilds its model per round,
     client_fit_model.py:155-157; here only the optimizer moments reset).
+
+    Transformed layouts: when ``model_config.stem_layout`` is a
+    space-to-depth variant, ``images`` may instead arrive PRE-PACKED as
+    ``[C, steps, B, H/2, W/2, 4*ch]`` (``data.pipeline.space_to_depth_images``
+    — same bytes, packed on the host instead of on device); the round
+    program consumes either staging layout (pick one per federation — the
+    two compile to different programs). Masks stay full-resolution always.
     """
     model_config = model_config or ModelConfig()
     _require_axes(mesh, CLIENTS, BATCH)
     model = ResUNet(config=model_config, bn_axis_name=BATCH)
+    in_ch = model_config.in_channels
+    packed_ok = model_config.stem_layout != "reference"
+
+    def validate_channels(images) -> None:
+        ch = images.shape[-1]
+        allowed = (in_ch, 4 * in_ch) if packed_ok else (in_ch,)
+        if ch not in allowed:
+            raise ValueError(
+                f"images carry {ch} channels; stem_layout="
+                f"{model_config.stem_layout!r} accepts {allowed} "
+                "(4x = space_to_depth-packed staging)"
+            )
 
     def apply_fn(params, batch_stats, imgs):
         logits, mutated = model.apply(
@@ -310,7 +333,7 @@ def build_federated_round(
         inner_axis=BATCH,
         apply_fn=apply_fn,
         image_spec=P(CLIENTS, None, BATCH),
-        validate_data=lambda images: None,
+        validate_data=validate_channels,
         pos_weight=pos_weight,
         remat=remat,
     )
@@ -339,6 +362,16 @@ def build_spatial_federated_round(
     from fedcrack_tpu.parallel.spatial import SPACE, _validate_shape, spatial_apply
 
     model_config = model_config or ModelConfig()
+    if model_config.stem_layout != "reference" or model_config.res_layout != "reference":
+        # The spatial forward re-implements the reference op-by-op with halo
+        # exchange (parallel.spatial's per-op geometry table); the layout
+        # transforms repack H/W into channels, which would change every halo
+        # width. Layout levers target the per-chip-resident planes.
+        raise ValueError(
+            "spatial sharding supports the reference layout only; got "
+            f"stem_layout={model_config.stem_layout!r}, "
+            f"res_layout={model_config.res_layout!r}"
+        )
     _require_axes(mesh, CLIENTS, SPACE)
     n_space = mesh.shape[SPACE]
 

@@ -43,6 +43,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from fedcrack_tpu.configs import ModelConfig
+from fedcrack_tpu.jaxcompat import psum_if_no_auto, shard_map
 from fedcrack_tpu.models.resunet import _BN_EPSILON, _BN_MOMENTUM, upsample2x
 from fedcrack_tpu.ops.pallas_bce import fused_segmentation_metrics
 from fedcrack_tpu.train.local import make_optimizer
@@ -212,6 +213,16 @@ def spatial_apply(
     axis).
     """
     cfg = config or ModelConfig()
+    if cfg.stem_layout != "reference" or cfg.res_layout != "reference":
+        # The per-op halo geometry above is derived for the reference ops;
+        # silently computing the reference program under a transformed-layout
+        # config would make the flag a no-op here. (Parameter shapes are
+        # layout-invariant, so the VALUES would even be right — but a config
+        # that claims a layout must either run it or refuse.)
+        raise ValueError(
+            "spatial_apply supports the reference layout only; got "
+            f"stem_layout={cfg.stem_layout!r}, res_layout={cfg.res_layout!r}"
+        )
     p = variables["params"]
     bs = variables["batch_stats"]
     sync = tuple(sync_axes) if sync_axes is not None else (axis_name,)
@@ -325,7 +336,7 @@ def build_spatial_predict(
         return jax.nn.sigmoid(logits)
 
     jitted = jax.jit(
-        jax.shard_map(fwd, mesh=mesh, in_specs=(P(), spec), out_specs=spec)
+        shard_map(fwd, mesh=mesh, in_specs=(P(), spec), out_specs=spec)
     )
 
     def predict_fn(variables, images):
@@ -383,7 +394,9 @@ def build_spatial_train_step(
         # already psums the per-shard cotangents to keep the gradient
         # replicated; with equal-sized shards dividing by the shard count
         # turns that sum of local-mean gradients into the gradient of the
-        # global-mean loss.
+        # global-mean loss. Pre-vma JAX performs NO such AD psum — jaxcompat
+        # inserts the equivalent explicit one there (identity on current JAX).
+        grads = psum_if_no_auto(grads, sync)
         n_shards = 1
         for a in sync:
             n_shards *= mesh.shape[a]
@@ -399,7 +412,7 @@ def build_spatial_train_step(
         return new_params, new_stats, new_opt_state, metrics
 
     jitted = jax.jit(
-        jax.shard_map(
+        shard_map(
             step,
             mesh=mesh,
             in_specs=(P(), P(), P(), spec, spec),

@@ -20,6 +20,11 @@ shard_map round program on CPU, and the compiled kernel needs a real TPU;
 numerics parity is tests/test_pallas_bce.py's job):
     python -m fedcrack_tpu.tools.ab_pallas_bce --sizes 32 --steps 2 \
         --batch 2 --reps 1 --impls jnp --dtype float32 --out /tmp/ab.json
+
+Artifact schema: ``points[<dtype>_<size>] = {"impls": {<impl>: point...},
+"speedup_first_over_second": float?}`` — per-impl dicts under "impls",
+derived scalars as sibling keys (never mixed into the impl map). bench.py's
+layout A/B reuses this shape.
 """
 
 from __future__ import annotations
@@ -158,12 +163,17 @@ def run_ab(args) -> dict:
                     "per_step_ms": round(slope * 1e3, 4) if fit_ok else None,
                     "mfu": None if util is None else round(util, 4),
                 }
+            # Schema note (ADVICE r5 #3): per-impl point dicts live under
+            # "impls"; derived scalars (the speedup) are SIBLING keys, so
+            # consumers can iterate points[key]["impls"] with no non-dict
+            # special case. bench.py's layout A/B emits the same shape.
+            point = {"impls": pts}
             if all(pts[i]["per_step_ms"] is not None for i in impls) and len(impls) == 2:
                 a, b = impls
-                pts["speedup_first_over_second"] = round(
+                point["speedup_first_over_second"] = round(
                     pts[b]["per_step_ms"] / pts[a]["per_step_ms"], 4
                 )
-            out["points"][f"{args.dtype}_{img}"] = pts
+            out["points"][f"{args.dtype}_{img}"] = point
             del si, sm, si_long, sm_long
     finally:
         if prior_impl is None:

@@ -27,6 +27,13 @@ passes, see tests/test_h5_import.py):
 Layer matching is by layer *type* (read from the h5 weight names), in model
 order within each type, with every tensor shape validated against the target
 Flax parameter — a mismatch raises instead of silently mis-seeding.
+
+Layout transforms (``ModelConfig.stem_layout``/``res_layout``) never touch
+this importer: parameter shapes are layout-invariant (the transformed
+kernels are derived in-forward, models/resunet.py), so one imported
+checkpoint seeds every layout and produces bit-exact logits under
+``stem_layout="s2d"``/``res_layout="packed"`` (pinned in
+tests/test_h5_import.py).
 """
 
 from __future__ import annotations

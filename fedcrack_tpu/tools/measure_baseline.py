@@ -522,12 +522,11 @@ def _apply_platform_env() -> None:
         if p.strip()
     ]
     if (plats and plats[0] == "cpu") or (flag and not plats):
-        try:
-            if flag:
-                jax.config.update("jax_num_cpu_devices", int(flag.group(1)))
-            jax.config.update("jax_platforms", "cpu")
-        except RuntimeError:
-            pass  # backends already initialized; measure where we are
+        # Version-tolerant CPU-platform routing (jaxcompat); no-op once
+        # backends are initialized — measure where we are then.
+        from fedcrack_tpu.jaxcompat import ensure_cpu_devices
+
+        ensure_cpu_devices(int(flag.group(1)) if flag else None)
 
 
 def main(argv=None) -> int:

@@ -100,7 +100,10 @@ def train_step(
     host->device traffic, ``data.pipeline.as_model_batch``) — the on-device
     normalization reproduces the float32 staging values bit for bit (step
     outputs then differ only by XLA's usual program-to-program
-    reduction-order noise).
+    reduction-order noise). When the model config selects a space-to-depth
+    ``stem_layout``, images may additionally arrive pre-packed
+    (``data.pipeline.space_to_depth_images``) — the model accepts either
+    layout; masks are always full-resolution.
     """
     images, masks = as_model_batch(*batch)
 

@@ -48,6 +48,20 @@ def test_bench_smoke_emits_driver_contract():
         # noisy host; the naive fallback must always be there.
         assert (point["per_step_ms"] or point["naive_per_step_ms"]) > 0
         assert point["flops_per_step"] > 0
+    # Round-6 layout A/B: on a host fast enough to fund it the section must
+    # carry the ab_pallas_bce artifact schema (per-variant dicts under
+    # "impls", ratios as sibling keys); when the budget excluded it, the
+    # skip must be RECORDED — never silent absence.
+    layout_points = detail.get("layout_ab", {})
+    if layout_points:
+        for point in layout_points.values():
+            assert all(isinstance(v, dict) for v in point["impls"].values())
+            assert "reference" in point["impls"]
+            assert point["flops_per_step_canonical"] > 0
+    else:
+        assert any(
+            s["section"].startswith("layout_ab_") for s in detail["skipped"]
+        )
     host = detail["host_plane"]
     reconstructed = (
         detail["n_clients"] * detail["steps"] * host["per_step_compute_ms"]
@@ -103,5 +117,9 @@ def test_bench_budget_skips_sections_but_still_emits():
     assert "host_plane" in skipped
     assert "sweep_48" in skipped
     assert "batch_curve" in skipped
+    # The layout A/B prices a 2-variant comparison before spending anything
+    # (even the long-scan tiling) and records its exclusion per dtype.
+    assert "layout_ab_bfloat16_32" in skipped
+    assert "layout_ab_float32_32" in skipped
     assert skipped["sweep_48"]["reason"] == "estimate exceeds remaining budget"
     assert detail["budget"]["budget_s"] == 1.0

@@ -37,7 +37,12 @@ def test_json_round_trip_preserves_everything():
         server_optimizer="fedyogi",
         wire_dtype="bfloat16",
         best_path="/tmp/b.msgpack",
-        model=ModelConfig(img_size=256, compute_dtype="bfloat16"),
+        model=ModelConfig(
+            img_size=256,
+            compute_dtype="bfloat16",
+            stem_layout="s2d",
+            res_layout="packed",
+        ),
         data=DataConfig(img_size=256, batch_size=32, partition="skew"),
     )
     assert FedConfig.from_json(cfg.to_json()) == cfg

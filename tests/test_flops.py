@@ -57,6 +57,23 @@ def test_train_step_is_forward_times_multiplier():
     )
 
 
+def test_flops_are_canonical_across_layouts():
+    """MFU-honesty invariant (round 6): the layout transforms re-express the
+    same math with zero-extended kernels, and the FLOPs model must charge
+    every layout the REFERENCE topology — an A/B whose transformed variant
+    got billed its structural-zero MACs would report inflated MFU."""
+    for img in (32, 128):
+        ref = train_step_flops(ModelConfig(img_size=img), 4)
+        for stem, res in (
+            ("s2d", "reference"),
+            ("s2d_full", "reference"),
+            ("reference", "packed"),
+            ("s2d", "packed"),
+        ):
+            cfg = ModelConfig(img_size=img, stem_layout=stem, res_layout=res)
+            assert train_step_flops(cfg, 4) == ref
+
+
 def test_peak_flops_env_override_and_unknown_kind(monkeypatch):
     monkeypatch.setenv("FEDCRACK_PEAK_TFLOPS", "197")
     assert device_peak_flops() == pytest.approx(197e12)

@@ -1,12 +1,29 @@
-"""ResUNet shape/structure parity with SURVEY.md §2.3."""
+"""ResUNet shape/structure parity with SURVEY.md §2.3, plus the layout-
+transform invariants (round 6): the space-to-depth stem and channel-packed
+residual projections are exact re-expressions of the reference math over the
+SAME parameter tree — reverting or degrading a transform fails here, not
+just in a benchmark."""
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from fedcrack_tpu.configs import ModelConfig
 from fedcrack_tpu.models import ResUNet, get_model
-from fedcrack_tpu.models.resunet import init_variables, predict, upsample2x
+from fedcrack_tpu.models.resunet import (
+    depth_to_space,
+    fold_stem_kernel_s2d,
+    fold_stem_kernel_s2d_full,
+    init_variables,
+    pack_res_kernel,
+    predict,
+    space_to_depth,
+    unfold_stem_kernel_s2d,
+    unfold_stem_kernel_s2d_full,
+    unpack_res_kernel,
+    upsample2x,
+)
 
 
 @pytest.fixture(scope="module")
@@ -110,6 +127,155 @@ def test_jit_compiles_once_static_shapes(variables):
     assert fn._cache_size() == 1
     fn(variables, x + 1).block_until_ready()
     assert fn._cache_size() == 1
+
+
+# ---- layout transforms (round 6) -------------------------------------------
+
+
+def _layout_cfg(img_size=128, **kw):
+    return ModelConfig(img_size=img_size, **kw)
+
+
+def test_space_to_depth_channel_order_and_inverse():
+    """Packed channel = (di*2+dj)*C + c — the documented block-position-major
+    order every fold/packing helper and the host-side stager rely on."""
+    x = jnp.arange(2 * 2 * 3, dtype=jnp.float32).reshape(1, 2, 2, 3)
+    p = space_to_depth(x)
+    assert p.shape == (1, 1, 1, 12)
+    for di in range(2):
+        for dj in range(2):
+            for c in range(3):
+                assert float(p[0, 0, 0, (di * 2 + dj) * 3 + c]) == float(
+                    x[0, di, dj, c]
+                )
+    assert jnp.array_equal(depth_to_space(p), x)
+
+
+def test_host_and_device_space_to_depth_agree():
+    """data.pipeline.space_to_depth_images (staging twin) must pack
+    identically to the model's device-side transform — on batch arrays AND
+    the [C, steps, B, ...] round layout, uint8 and float32."""
+    from fedcrack_tpu.data.pipeline import space_to_depth_images
+
+    rng = np.random.default_rng(0)
+    batch = rng.integers(0, 255, (2, 32, 32, 3), dtype=np.uint8)
+    assert np.array_equal(
+        space_to_depth_images(batch), np.asarray(space_to_depth(jnp.asarray(batch)))
+    )
+    stacked = rng.random((2, 3, 2, 32, 32, 3), dtype=np.float32)
+    packed = space_to_depth_images(stacked)
+    assert packed.shape == (2, 3, 2, 16, 16, 12)
+    assert np.array_equal(
+        packed[1, 2], np.asarray(space_to_depth(jnp.asarray(stacked[1, 2])))
+    )
+
+
+def test_fold_unfold_round_trips_are_exact(variables):
+    """The weight-export inverses recover the reference kernels bitwise."""
+    k = variables["params"]["stem_conv"]["kernel"]
+    assert jnp.array_equal(unfold_stem_kernel_s2d(fold_stem_kernel_s2d(k)), k)
+    assert jnp.array_equal(
+        unfold_stem_kernel_s2d_full(fold_stem_kernel_s2d_full(k)), k
+    )
+    r = variables["params"]["enc0_res"]["kernel"]
+    assert jnp.array_equal(unpack_res_kernel(pack_res_kernel(r)), r)
+
+
+def test_layout_flags_do_not_change_params(variables):
+    """Initialization is IDENTICAL across layouts (same param tree, same RNG
+    folds) — the property that keeps h5 import/export, FedAvg, the wire
+    format and checkpoints layout-blind."""
+    for stem, res in (("s2d", "reference"), ("s2d_full", "packed"), ("s2d", "packed")):
+        cfg = _layout_cfg(stem_layout=stem, res_layout=res)
+        v = init_variables(jax.random.key(0), cfg)
+        ref_leaves = jax.tree_util.tree_leaves(variables)
+        for a, b in zip(ref_leaves, jax.tree_util.tree_leaves(v)):
+            assert a.shape == b.shape
+            assert jnp.array_equal(a, b)
+
+
+@pytest.mark.parametrize("img", [128, 256])
+def test_s2d_layout_bit_exact_random_and_fixture_inputs(variables, img):
+    """THE transform pin (ISSUE r6): stem_layout='s2d' + res_layout='packed'
+    reproduce the reference layout's logits BIT-EXACTLY at 128 and 256 px,
+    on random inputs and on the synthetic crack fixtures — same weights,
+    different executed program. (Weights are resolution-independent, so the
+    module fixture serves both sizes.)"""
+    from fedcrack_tpu.data.synthetic import synth_crack_batch
+
+    ref_model = ResUNet(config=_layout_cfg(img))
+    s2d_model = ResUNet(
+        config=_layout_cfg(img, stem_layout="s2d", res_layout="packed")
+    )
+
+    rand = jax.random.uniform(jax.random.key(7), (2, img, img, 3), jnp.float32)
+    fixture, _ = synth_crack_batch(2, img_size=img, seed=3)
+    for x in (rand, jnp.asarray(fixture)):
+        ref = ref_model.apply(variables, x, train=False)
+        out = s2d_model.apply(variables, x, train=False)
+        assert jnp.array_equal(ref, out), "s2d layout diverged from reference"
+
+
+def test_s2d_layout_accepts_packed_input(variables):
+    """The staged-packed input path ([N,H/2,W/2,12], space_to_depth) is the
+    same program family and stays bit-exact for both s2d variants."""
+    x = jax.random.uniform(jax.random.key(9), (2, 128, 128, 3), jnp.float32)
+    xp = space_to_depth(x)
+    ref = ResUNet(config=_layout_cfg()).apply(variables, x, train=False)
+    for stem in ("s2d", "s2d_full"):
+        model = ResUNet(config=_layout_cfg(stem_layout=stem))
+        unpacked = model.apply(variables, x, train=False)
+        packed = model.apply(variables, xp, train=False)
+        assert jnp.array_equal(unpacked, packed)
+        if stem == "s2d":
+            assert jnp.array_equal(ref, packed)
+
+
+def test_s2d_train_mode_forward_bit_exact(variables):
+    """Train-mode forward (BN batch moments) is bit-exact too — the property
+    that made the mesh-round Adam step reproduce reference-layout weights
+    bitwise in the cross-plane check."""
+    x = jax.random.uniform(jax.random.key(11), (2, 128, 128, 3), jnp.float32)
+    ref_logits, ref_state = ResUNet(config=_layout_cfg()).apply(
+        variables, x, train=True, mutable=["batch_stats"]
+    )
+    s2d_logits, s2d_state = ResUNet(
+        config=_layout_cfg(stem_layout="s2d", res_layout="packed")
+    ).apply(variables, x, train=True, mutable=["batch_stats"])
+    assert jnp.array_equal(ref_logits, s2d_logits)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ref_state), jax.tree_util.tree_leaves(s2d_state)
+    ):
+        assert jnp.array_equal(a, b)
+
+
+def test_s2d_full_is_exact_arithmetic_but_reassociated(variables):
+    """stem_layout='s2d_full' computes the same math (same multiplies plus
+    exact zero taps) but XLA reassociates the longer contraction: agreement
+    is ulp-level, NOT bitwise — the documented reason the fully folded
+    stride-1 stem is an A/B probe while 's2d' is the bit-exact default
+    transform (models/resunet.py module docstring)."""
+    x = jax.random.uniform(jax.random.key(13), (2, 128, 128, 3), jnp.float32)
+    ref = ResUNet(config=_layout_cfg()).apply(variables, x, train=False)
+    out = ResUNet(config=_layout_cfg(stem_layout="s2d_full")).apply(
+        variables, x, train=False
+    )
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=1e-4, rtol=1e-4)
+
+
+def test_invalid_layout_flags_rejected():
+    with pytest.raises(ValueError, match="stem_layout"):
+        ModelConfig(stem_layout="nope")
+    with pytest.raises(ValueError, match="res_layout"):
+        ModelConfig(res_layout="nope")
+
+
+def test_s2d_rejects_wrong_channel_count():
+    cfg = _layout_cfg(stem_layout="s2d")
+    v = init_variables(jax.random.key(0), cfg)
+    model = ResUNet(config=cfg)
+    with pytest.raises(ValueError, match="channels"):
+        model.apply(v, jnp.zeros((1, 64, 64, 5)), train=False)
 
 
 def test_head_commutes_with_final_upsample(variables):

@@ -23,7 +23,7 @@ from fedcrack_tpu.parallel.multihost import (
 
 @pytest.fixture
 def not_initialized(monkeypatch):
-    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False, raising=False)
 
 
 def test_explicit_args_must_be_complete(not_initialized):
@@ -69,7 +69,7 @@ def test_autodetect_failure_means_single_host(not_initialized, monkeypatch):
 
 
 def test_already_initialized_short_circuits(monkeypatch):
-    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True, raising=False)
 
     def boom(**kw):
         raise AssertionError("initialize must not be called again")
@@ -95,6 +95,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
 sys.path.insert(0, {repo!r})
+from fedcrack_tpu.jaxcompat import shard_map
 from fedcrack_tpu.parallel.multihost import (
     global_mesh_devices, initialize_if_needed, is_coordinator,
 )
@@ -109,7 +110,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 mesh = Mesh(devs, ("clients",))
 def f(v):
     return jax.lax.psum(v, "clients")
-y = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P(None), out_specs=P(None)))(
+y = jax.jit(shard_map(f, mesh=mesh, in_specs=P(None), out_specs=P(None)))(
     jnp.ones((1,), jnp.float32)
 )
 total = float(np.asarray(jax.device_get(y))[0])
@@ -162,11 +163,11 @@ def _launch_two_workers(script_text: str, tmp_path, timeout: float) -> list[str]
 
 _ROUND_WORKER = """
 import sys
-import jax
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
-pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
 sys.path.insert(0, {repo!r})
+import jax
+from fedcrack_tpu.jaxcompat import ensure_cpu_devices
+ensure_cpu_devices(4)
+pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from fedcrack_tpu.configs import ModelConfig

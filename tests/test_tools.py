@@ -132,7 +132,11 @@ def test_ab_pallas_bce_harness_smoke(tmp_path):
     )
     assert rc == 0
     art = json.loads(out.read_text())
-    pts = art["points"]["float32_32"]
+    point = art["points"]["float32_32"]
+    # ADVICE r5 #3: per-impl dicts live under "impls"; derived scalars are
+    # sibling keys — impl iteration needs no non-dict special case.
+    pts = point["impls"]
+    assert all(isinstance(v, dict) for v in pts.values())
     assert pts["jnp"]["round_s_short"] > 0
     assert pts["jnp"]["round_s_long"] > 0
     # per_step_ms may be None if CPU timing noise defeats the 2-point fit at

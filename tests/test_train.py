@@ -256,15 +256,22 @@ def test_recalibrate_batch_stats_fixes_eval_mode():
     ds = ArrayDataset(images, masks, batch_size=8, seed=0)
     state = create_train_state(jax.random.key(0), CFG32, learning_rate=1e-3)
     state, _ = local_fit(state, ds, epochs=4, pos_weight=5.0)
-    stale = evaluate(state, ds)
+    stale = evaluate(state, ds, pos_weight=5.0)
     cal = recalibrate_batch_stats(state, ds, CFG32)
-    fresh = evaluate(cal, ds)
+    fresh = evaluate(cal, ds, pos_weight=5.0)
     # params untouched; only batch_stats move
     for a, b in zip(
         jax.tree_util.tree_leaves(state.params), jax.tree_util.tree_leaves(cal.params)
     ):
         assert np.array_equal(a, b)
-    assert fresh["loss"] < stale["loss"], (stale, fresh)
+    # The collapse this test exists to catch is an all-background predictor
+    # (near-init running stats -> zero crack recall). Pin the mechanism on
+    # SEGMENTATION quality: an all-background model scores IoU 0 however
+    # its BCE scalar lands (background dominates ~93% of pixels, so the
+    # loss ordering at this 8-step toy scale is backend-trajectory luck —
+    # it flipped between XLA versions while IoU told the same story).
+    assert fresh["iou"] > stale["iou"], (stale, fresh)
+    assert fresh["iou"] > 0.1, (stale, fresh)
     # calibration must not advance the dataset's shuffle epoch — a seeded
     # run has to reproduce identically with calibration on or off
     epoch_before = ds._epoch
