@@ -105,6 +105,57 @@ REF_EPOCHS = int(os.environ.get("FEDCRACK_BENCH_REF_EPOCHS", "10"))
 REF_STEPS = int(os.environ.get("FEDCRACK_BENCH_REF_STEPS", "388"))
 REF_SCALE = os.environ.get("FEDCRACK_BENCH_REF_SCALE", "auto")
 REF_256 = os.environ.get("FEDCRACK_BENCH_REF_256", "0") == "1"
+# Segment count for the epoch-segmented execution A/B (round 7) and the
+# chunked 256 px reference-scale point: K device-resident-carry programs of
+# REF_EPOCHS/K epochs each (parallel.fedavg_mesh.SegmentedRound —
+# bit-identical to the monolithic scan). Default: one segment per epoch.
+SEGMENTS = int(os.environ.get("FEDCRACK_BENCH_SEGMENTS", str(REF_EPOCHS)))
+
+# ---- artifact schema contract -----------------------------------------------
+# Consumers (the driver's JSON parse, BASELINE.md updates, cross-round
+# comparisons) key on these names; tests/test_bench.py::test_detail_schema_*
+# guard them so a rename breaks CI instead of silently breaking artifact
+# readers. Every key is OPTIONAL in any given run (budget gating skips
+# sections) but, when present, must carry the declared type.
+DETAIL_SCHEMA: dict = {
+    "sweep": dict,
+    "skipped": list,
+    "budget": dict,
+    "reference_scale": dict,
+    "layout_ab": dict,
+    "segmented_pipeline": dict,
+    "host_plane": dict,
+    "batch_curve": dict,
+    "input_pipeline": dict,
+}
+# Per-point keys of detail.reference_scale.* and the per-arm dicts of
+# detail.segmented_pipeline.*: the staging/overlap decomposition contract.
+REF_POINT_SCHEMA: dict = {
+    "round_ms": (int, float),
+    "round_plus_restage_ms": (int, float, type(None)),
+    "staging_hidden_frac": (int, float, type(None)),
+}
+
+
+def validate_detail(detail: dict) -> list:
+    """Schema-contract violations in an emitted ``detail`` payload (empty =
+    clean). Pure checks — shared by the bench itself and the tier-1 guard
+    test so the contract cannot drift from the code that writes it."""
+    bad = []
+    for key, typ in DETAIL_SCHEMA.items():
+        if key in detail and not isinstance(detail[key], typ):
+            bad.append(f"detail[{key!r}] is {type(detail[key]).__name__}, wants {typ}")
+    for name, point in (detail.get("reference_scale") or {}).items():
+        for key, typs in REF_POINT_SCHEMA.items():
+            if key in point and not isinstance(point[key], typs):
+                bad.append(f"reference_scale[{name!r}][{key!r}]: {type(point[key]).__name__}")
+    for name, ab in (detail.get("segmented_pipeline") or {}).items():
+        for arm in ("monolithic", "segmented"):
+            for key, typs in REF_POINT_SCHEMA.items():
+                val = (ab.get(arm) or {}).get(key)
+                if val is not None and not isinstance(val, typs):
+                    bad.append(f"segmented_pipeline[{name!r}][{arm}][{key!r}]")
+    return bad
 
 # Default sized from measured section costs on the TPU-tunnel host (round 4):
 # sweep_128 ~260 s + ref bf16 ~233 s + ref f32 ~132 s + host ~75 s ≈ 700 s on
@@ -174,6 +225,16 @@ def _set_payload(metric, value, vs_baseline, detail) -> None:
 def _emit() -> None:
     if not _OUT["emitted"] and _OUT["payload"] is not None:
         _OUT["emitted"] = True
+        try:
+            # Self-check against the declared artifact schema at write time:
+            # a violating payload still emits (a flagged artifact beats a
+            # dead run) but carries the violations where consumers and the
+            # committed-artifact guard test will surface them.
+            bad = validate_detail(_OUT["payload"].get("detail") or {})
+            if bad:
+                _OUT["payload"]["schema_violations"] = bad
+        except Exception:
+            pass  # the schema self-check must never kill the artifact
         print(json.dumps(_OUT["payload"]), flush=True)
 
 
@@ -239,6 +300,25 @@ def _stage_timed(images, masks, mesh):
     _XFER["s"] += dt
     _XFER["bytes"] += images.nbytes + masks.nbytes
     return si, sm, dt
+
+
+def _stage_timed_chunks(images, masks, mesh, n_chunks: int):
+    """Chunked staging with the transfer rate recorded: one device_put +
+    barrier per step-range chunk (``data.pipeline.split_epoch_slab``), so no
+    single transfer exceeds 1/n_chunks of the epoch slab — the grain the
+    segmented round consumes, and the tunnel-safe form of the 1.6 GB 256 px
+    epoch (round-5 isolation logs: the remote helper dies on the monolithic
+    transfer + 3,880-step program)."""
+    from fedcrack_tpu.data.pipeline import split_epoch_slab
+    from fedcrack_tpu.parallel import stage_round_data
+
+    t0 = time.perf_counter()
+    ic, mc = split_epoch_slab(images, masks, n_chunks)
+    pairs = [stage_round_data(i, m, mesh) for i, m in zip(ic, mc)]
+    dt = time.perf_counter() - t0
+    _XFER["s"] += dt
+    _XFER["bytes"] += images.nbytes + masks.nbytes
+    return tuple(p[0] for p in pairs), tuple(p[1] for p in pairs), dt
 
 
 def _fits(est_s: float, reserve_s: float = 15.0) -> bool:
@@ -835,7 +915,14 @@ def _ref_host_arrays(img: int):
 
 
 def _bench_reference_scale(
-    img: int, dtype: str, device, mesh, *, full: bool = True, reuse: dict | None = None
+    img: int,
+    dtype: str,
+    device,
+    mesh,
+    *,
+    full: bool = True,
+    reuse: dict | None = None,
+    segments: int = 0,
 ):
     """One-program federated round at the reference's true workload:
     REF_EPOCHS local epochs over REF_STEPS batches of BATCH, single client,
@@ -858,22 +945,42 @@ def _bench_reference_scale(
     dtype-independent, so re-measuring transfers for the f32 ratio point
     would spend tunnel minutes re-learning the same number.
 
+    ``segments > 0`` runs the round through the epoch-segmented execution
+    (``build_federated_round_segments``, bit-identical weights): each
+    compiled program is REF_STEPS*REF_EPOCHS/segments steps — the chunked
+    form that compiles at 256 px where the 3,880-step monolith fails
+    (VERDICT r5 #6) — and ``run_mesh_federation`` streams the restage one
+    chunk per in-flight segment.
+
     Returns ``(point_dict, reuse_dict)``; point_dict is None if the budget
     ran out after warmup (the partial JSON then omits this point).
     """
     from fedcrack_tpu.configs import ModelConfig
     from fedcrack_tpu.obs.flops import mfu, train_step_flops
-    from fedcrack_tpu.parallel import build_federated_round, run_mesh_federation
+    from fedcrack_tpu.parallel import (
+        build_federated_round,
+        build_federated_round_segments,
+        run_mesh_federation,
+    )
     from fedcrack_tpu.train.local import create_train_state
 
     config = ModelConfig(img_size=img, compute_dtype=dtype)
     state0 = create_train_state(jax.random.key(SEED), config)
-    round_fn = build_federated_round(
-        mesh, config, learning_rate=1e-3, local_epochs=REF_EPOCHS
-    )
+    if segments:
+        round_fn = build_federated_round_segments(
+            mesh, config, learning_rate=1e-3, local_epochs=REF_EPOCHS,
+            segments=segments,
+        )
+    else:
+        round_fn = build_federated_round(
+            mesh, config, learning_rate=1e-3, local_epochs=REF_EPOCHS
+        )
     if reuse is None:
         images, masks = _ref_host_arrays(img)
-        si, sm, init_stage_s = _stage_timed(images, masks, mesh)
+        if segments:
+            si, sm, init_stage_s = _stage_timed_chunks(images, masks, mesh, segments)
+        else:
+            si, sm, init_stage_s = _stage_timed(images, masks, mesh)
         reuse = {
             "images": images,
             "masks": masks,
@@ -920,6 +1027,7 @@ def _bench_reference_scale(
         "steps_per_epoch": REF_STEPS,
         "batch": BATCH,
         "total_steps": total_steps,
+        "segments": segments,
         "staging_bytes": int(images.nbytes + masks.nbytes),
         "warm_round_walls_s": warm_walls,
         "round_s_raw": round_s,
@@ -929,13 +1037,19 @@ def _bench_reference_scale(
     }
 
     if full:
-        stage_s = _median_time(lambda: _stage_timed(images, masks, mesh), reps=2)
+        if segments:
+            stage_s = _median_time(
+                lambda: _stage_timed_chunks(images, masks, mesh, segments), reps=2
+            )
+        else:
+            stage_s = _median_time(lambda: _stage_timed(images, masks, mesh), reps=2)
         time.sleep(2.0)  # drain staging traffic before the overlap phase
         # Double-buffered multi-round federation through the PACKAGE driver:
         # data_fn re-returns the epoch arrays, so every round restages while
         # the previous round computes — per-round wall is max(round, staging)
         # plus the unhidden residue.
         overlap_rounds = reps + 1
+        timeline = None
         if _remaining() > (overlap_rounds * max(stage_s, round_s)) * 1.2 + 10.0:
             _, records = run_mesh_federation(
                 round_fn,
@@ -946,6 +1060,11 @@ def _bench_reference_scale(
             )
             walls = [r.wall_clock_s for r in records[:-1]]  # last round: no restage
             overlap_s = float(np.median(walls[1:] if len(walls) > 2 else walls))
+            if segments and len(records) > 1:
+                # Segmented path: the driver's per-segment host timeline
+                # (dispatch + the next-round chunk transfer that rode under
+                # each segment) from a post-compile overlapped round.
+                timeline = list(records[1].segments)
         else:
             overlap_s = None
         reuse = dict(reuse, stage_s=stage_s, overlap=overlap_s)
@@ -964,6 +1083,8 @@ def _bench_reference_scale(
                 ),
             }
         )
+        if timeline is not None:
+            point["segment_timeline"] = timeline
     else:
         # Staging cost is dtype-independent (same uint8 bytes) and inherited;
         # the overlap decomposition is NOT re-derived here — it would mix the
@@ -977,6 +1098,123 @@ def _bench_reference_scale(
         }
     )
     return point, reuse
+
+
+def _bench_segmented_pipeline(
+    img: int,
+    dtype: str,
+    device,
+    mesh,
+    reuse: dict,
+    mono_point: dict,
+    *,
+    with_overlap: bool = True,
+):
+    """Monolithic vs epoch-segmented round execution at reference scale
+    (round 7's deliverable): the same REF_EPOCHS x REF_STEPS trajectory run
+    as K= SEGMENTS device-resident-carry programs with chunk-grain streamed
+    restaging, against the monolithic one-program round already measured in
+    ``reference_scale``. The weights are bit-identical by construction
+    (test-pinned), so the ONLY honest question is the pipeline: dispatch
+    overhead of K programs vs 1, and how much of the restage hides under
+    compute at segment grain vs round grain (``staging_hidden_frac``).
+
+    Reuses the monolithic point's staged buffers and host arrays (same
+    uint8 bytes); ``with_overlap=False`` measures only the compute round
+    (the f32 arm mirrors the monolithic f32 point's asymmetry). Returns
+    None when the budget dies mid-measurement.
+    """
+    from fedcrack_tpu.configs import ModelConfig
+    from fedcrack_tpu.parallel import (
+        build_federated_round_segments,
+        run_mesh_federation,
+    )
+    from fedcrack_tpu.train.local import create_train_state
+
+    k = SEGMENTS if SEGMENTS > 0 and REF_EPOCHS % SEGMENTS == 0 else REF_EPOCHS
+    config = ModelConfig(img_size=img, compute_dtype=dtype)
+    state0 = create_train_state(jax.random.key(SEED), config)
+    seg_round = build_federated_round_segments(
+        mesh, config, learning_rate=1e-3, local_epochs=REF_EPOCHS, segments=k
+    )
+    images, masks = reuse["images"], reuse["masks"]
+    si, sm = reuse["si"], reuse["sm"]
+    active = np.ones(1, np.float32)
+    n_samp = np.full(1, float(REF_STEPS * BATCH), np.float32)
+    run = _make_round_runner(seg_round, state0.variables, si, sm, active, n_samp)
+
+    warm_walls = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        run()
+        warm_walls.append(round(time.perf_counter() - t0, 3))
+    time.sleep(2.0)
+    reps = max(1, min(REPS, 3))
+    if _remaining() < warm_walls[-1] * reps + 10.0:
+        return None
+    seg_round_s = _median_time(run, reps=reps)
+
+    stage_s = reuse.get("stage_s")
+    overlap_s = None
+    timeline = None
+    if with_overlap and stage_s:
+        overlap_rounds = reps + 1
+        if _remaining() > (overlap_rounds * max(stage_s, seg_round_s)) * 1.2 + 10.0:
+            _, records = run_mesh_federation(
+                seg_round,
+                state0.variables,
+                lambda r: (images, masks, active, n_samp),
+                overlap_rounds,
+                mesh,
+            )
+            walls = [r.wall_clock_s for r in records[:-1]]
+            overlap_s = float(np.median(walls[1:] if len(walls) > 2 else walls))
+            if len(records) > 1:
+                timeline = list(records[1].segments)
+
+    hidden = (
+        (stage_s + seg_round_s - overlap_s) / stage_s
+        if (overlap_s is not None and stage_s)
+        else None
+    )
+    segmented = {
+        "round_ms": round(seg_round_s * 1e3, 2),
+        "per_step_ms": round(seg_round_s / (REF_EPOCHS * REF_STEPS) * 1e3, 3),
+        "warm_round_walls_s": warm_walls,
+        "round_plus_restage_ms": (
+            None if overlap_s is None else round(overlap_s * 1e3, 2)
+        ),
+        "staging_hidden_frac": (
+            None if hidden is None else round(max(0.0, min(1.0, hidden)), 3)
+        ),
+    }
+    if timeline is not None:
+        segmented["segment_timeline"] = timeline
+    out = {
+        "segments": k,
+        "segment_epochs": REF_EPOCHS // k,
+        "img_size": img,
+        "dtype": dtype,
+        "monolithic": {
+            "round_ms": mono_point["round_ms"],
+            "round_plus_restage_ms": mono_point.get("round_plus_restage_ms"),
+            "staging_hidden_frac": mono_point.get("staging_hidden_frac"),
+        },
+        "segmented": segmented,
+        "round_speedup_mono_over_seg": round(
+            mono_point["round_s_raw"] / seg_round_s, 4
+        ),
+        "note": (
+            "same trajectory bit-for-bit (SegmentedRound exactness contract); "
+            "the comparison is pure pipeline — K-program dispatch overhead vs "
+            "chunk-grain staged-transfer streaming"
+        ),
+    }
+    mono_wall = mono_point.get("round_plus_restage_ms")
+    seg_wall = segmented["round_plus_restage_ms"]
+    if mono_wall and seg_wall:
+        out["round_plus_restage_speedup"] = round(mono_wall / seg_wall, 4)
+    return out
 
 
 def main() -> None:
@@ -1096,6 +1334,7 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
         REF_SCALE == "auto" and getattr(device, "platform", "") == "tpu"
     )
     reference_scale: dict = {}
+    segmented_pipeline: dict = {}
     reuse = None
     total_steps = REF_EPOCHS * REF_STEPS
     if run_ref:
@@ -1151,6 +1390,52 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
                 if reuse is not None
                 else "flagship point skipped, no staged data to reuse",
             )
+        # ---- segmented-pipeline A/B (round 7): the SAME reference-scale
+        # round as K epoch-segment programs with chunk-grain streamed
+        # restaging, vs the monolithic points above — reuses their staged
+        # buffers, so it must run before the epoch is dropped ----
+        for sp_dtype, with_ov in (("bfloat16", True), ("float32", False)):
+            mono_point = reference_scale.get(f"{sp_dtype}_{img}")
+            if mono_point is None or reuse is None:
+                _skip(
+                    skips,
+                    f"segmented_pipeline_{sp_dtype}_{img}",
+                    0.0,
+                    "monolithic reference-scale point missing; no baseline",
+                )
+                continue
+            mono_round_s = mono_point["round_s_raw"]
+            stage_est = reuse.get("stage_s") or _est_stage_s(data_bytes)
+            sp_est = (
+                (2 + reps) * mono_round_s
+                + (reps + 1) * max(stage_est, mono_round_s) * (1 if with_ov else 0)
+                + COMPILE_EST_S
+                + 4.0
+            )
+            if not _fits(sp_est):
+                _skip(
+                    skips,
+                    f"segmented_pipeline_{sp_dtype}_{img}",
+                    sp_est,
+                    "estimate exceeds remaining budget",
+                )
+                continue
+            t0 = time.monotonic()
+            sp_point = _bench_segmented_pipeline(
+                img, sp_dtype, device, ref_mesh, reuse, mono_point,
+                with_overlap=with_ov,
+            )
+            section_s[f"segmented_pipeline_{sp_dtype}"] = time.monotonic() - t0
+            if sp_point is not None:
+                segmented_pipeline[f"{sp_dtype}_{img}"] = sp_point
+            else:
+                _skip(
+                    skips,
+                    f"segmented_pipeline_{sp_dtype}_{img}",
+                    sp_est,
+                    "budget ran out mid-point",
+                )
+
         # The ref-128 epoch (~400 MB host + device) is dead weight for the
         # remaining sections — drop it before the 256px staging below.
         reuse = None
@@ -1163,6 +1448,8 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
     mesh_ref_f32_s = None
     if reference_scale:
         detail["reference_scale"] = reference_scale
+        if segmented_pipeline:
+            detail["segmented_pipeline"] = segmented_pipeline
         # Ratio denominator: the measured f32 ref round when it ran; else the
         # slope-reconstructed f32 round (conservative — slope excludes the
         # one-dispatch cost the measured round would include).
@@ -1463,15 +1750,25 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
         if _fits(est):
             t0 = time.monotonic()
             try:
+                # Round 7: measured via epoch-chunked execution — K programs
+                # of REF_STEPS steps each, staged as K chunk transfers. The
+                # monolithic form is exactly what this tunnel's remote
+                # compile helper 500s on (round 5: the 3,880-step program /
+                # 1.6 GB single transfer — bench_runs/ isolation logs);
+                # each 388-step segment is the same size class as the
+                # 128 px programs that compile fine.
                 point, _ = _bench_reference_scale(
-                    img, "bfloat16", device, ref_mesh, full=True
+                    img, "bfloat16", device, ref_mesh, full=True,
+                    segments=(
+                        SEGMENTS
+                        if SEGMENTS > 0 and REF_EPOCHS % SEGMENTS == 0
+                        else REF_EPOCHS
+                    ),
                 )
             except Exception as e:
-                # Observed on this tunnel (round 5): the remote compile
-                # helper dies on the 256 px 3,880-step program (its 1.6 GB
-                # staged epoch exceeds the helper's capacity, remat or not —
-                # bench_runs/ isolation logs). Record the failure as a skip;
-                # every earlier section's data is already in the payload.
+                # Even the chunked form can die on an exotic tunnel; record
+                # the failure as a skip — every earlier section's data is
+                # already in the payload.
                 point = None
                 _skip(skips, f"ref_scale_bfloat16_{img}", est, f"failed: {e!r:.180}")
             section_s[f"ref_bf16_{img}"] = time.monotonic() - t0

@@ -206,12 +206,33 @@ class FedConfig:
     # Mesh shape for the TPU data plane: (#federated clients, per-client DP).
     mesh_clients: int = 8
     mesh_batch: int = 1
+    # Epoch-segmented round execution (parallel.fedavg_mesh.SegmentedRound):
+    # 0 runs the round as ONE compiled program (the monolithic
+    # local_epochs x steps scan); K > 0 splits it into K device-resident-
+    # carry segment programs (K must divide local_epochs; K = local_epochs
+    # is one segment per epoch). Segmentation is bit-exact vs the monolith
+    # and unlocks segment-grain staging overlap plus 1/K-sized compiles
+    # (the 256 px reference-scale program only compiles chunked).
+    segments: int = 0
+    # With segments > 0: stream the next round's staging one step-range
+    # chunk per in-flight segment (True, epoch-grain double buffering)
+    # instead of one monolithic transfer per round (False). Peak staged
+    # HBM is ~2 epoch slabs either way; streaming keeps any single
+    # transfer 1/K the size and hides more of it under compute.
+    segment_overlap: bool = True
 
     def __post_init__(self) -> None:
         if self.data.img_size != self.model.img_size:
             raise ValueError(
                 "data.img_size and model.img_size must match; got "
                 f"{self.data.img_size} vs {self.model.img_size}"
+            )
+        if self.segments < 0:
+            raise ValueError(f"segments must be >= 0, got {self.segments}")
+        if self.segments > 0 and self.local_epochs % self.segments != 0:
+            raise ValueError(
+                f"segments={self.segments} must divide "
+                f"local_epochs={self.local_epochs} (epoch-grain segmentation)"
             )
         if self.wire_dtype not in ("float32", "bfloat16"):
             raise ValueError(

@@ -139,6 +139,33 @@ def space_to_depth_images(images: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(x.reshape(*lead, h // 2, w // 2, 4 * c))
 
 
+def split_epoch_slab(
+    images: np.ndarray, masks: np.ndarray, n_chunks: int
+) -> tuple[tuple[np.ndarray, ...], tuple[np.ndarray, ...]]:
+    """Split one round's ``[C, steps, B, ...]`` epoch slab into ``n_chunks``
+    contiguous step-range chunks (zero-copy views) for segment-grain staging.
+
+    The chunks concatenate back to the original along the steps axis, so a
+    round program consuming them in order is byte-identical to one consuming
+    the monolithic slab (``parallel.fedavg_mesh.SegmentedRound``). Chunk
+    boundaries follow ``np.array_split`` (first ``steps % n_chunks`` chunks
+    one step longer); ``n_chunks`` is clamped to ``steps`` so tiny rounds
+    never produce empty chunks."""
+    if images.shape[:3] != masks.shape[:3]:
+        raise ValueError(
+            f"images/masks round layouts disagree: {images.shape[:3]} vs "
+            f"{masks.shape[:3]}"
+        )
+    if n_chunks < 1:
+        raise ValueError(f"n_chunks must be >= 1, got {n_chunks}")
+    steps = images.shape[1]
+    n_chunks = min(n_chunks, steps)
+    bounds = np.array_split(np.arange(steps), n_chunks)
+    img_chunks = tuple(images[:, b[0] : b[-1] + 1] for b in bounds)
+    msk_chunks = tuple(masks[:, b[0] : b[-1] + 1] for b in bounds)
+    return img_chunks, msk_chunks
+
+
 def as_model_batch(images, masks):
     """Normalize a transport batch (possibly uint8, see ``transport_dtype``)
     to the model contract: float32 [0,1] images, float32 {0,1} masks.

@@ -17,7 +17,9 @@ from fedcrack_tpu.parallel.driver import (  # noqa: F401
     stage_round_data,
 )
 from fedcrack_tpu.parallel.fedavg_mesh import (  # noqa: F401
+    SegmentedRound,
     build_federated_round,
+    build_federated_round_segments,
     build_spatial_federated_round,
     mesh_fedavg,
     stack_client_data,

@@ -10,23 +10,49 @@ synchronous per-batch cv2 decode in the middle of the hot loop
 (reference: client_fit_model.py:30-43 inside fit, SURVEY.md §3.3) — the
 first-order bottleneck SURVEY.md §7 told us to replace.
 
+Two round execution modes (round 7):
+
+- **Monolithic** (``round_fn`` from ``build_federated_round``): the whole
+  round is one program and staging double-buffers at ROUND grain — one
+  ``device_put`` of the full epoch slab per round.
+- **Segmented** (a ``SegmentedRound`` from
+  ``build_federated_round_segments``): the round runs as K segment
+  programs with a device-resident donated carry, and the next round's
+  slab streams CHUNK BY CHUNK between segment dispatches
+  (``segment_overlap=True``), so a single monolithic transfer never sits
+  on the bus and the previous round's chunks are released as soon as the
+  round barrier passes — peak staged-data HBM is bounded by ~2 epoch
+  slabs (test-pinned via ``RoundRecord.max_live_staged_bytes``). Both
+  modes produce bit-identical weights (staging is data-independent and
+  the segmented program is byte-exact vs the monolithic scan).
+
 Round 3 proved the overlap inside ``bench.py`` only; this module is the
 reusable component (round-3 verdict "what's weak" #2): ``bench.py``'s
 reference-scale section, ``tools/measure_baseline``'s mesh rows, and
 ``tools/refscale_federation`` all drive rounds through it, and the overlap's
 correctness (same weights as sequential staging) is test-pinned.
+
+Mid-federation checkpoint/resume (round 7, VERDICT r5 #7): pass a
+``ckpt.manager.FedCheckpointer`` as ``checkpointer`` and the driver saves
+the global variables at every round boundary; a restarted session restores
+the checkpoint, passes the restored variables plus ``start_round`` and
+continues the same trajectory (bit-identical on the deterministic path —
+the data_fn is called with absolute round indices either way).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any, Callable
+from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fedcrack_tpu.data.pipeline import split_epoch_slab
+from fedcrack_tpu.parallel.fedavg_mesh import SegmentedRound
 
 CLIENTS, BATCH = "clients", "batch"
 
@@ -35,14 +61,25 @@ CLIENTS, BATCH = "clients", "batch"
 class RoundRecord:
     """One round's timing + metrics, host-side.
 
+    BOUNDARY-TERM NOTE (round 7): ``staging_s`` is the host-BLOCKING
+    staging time paid for THIS round's data, in both modes. Round
+    ``start_round``'s record carries the initial (never-overlapped)
+    staging; a sequential-mode round carries the post-barrier staging of
+    its own data (measured during the previous round's slot); an
+    overlapped round carries 0.0 because its staging rode under the
+    previous round's compute. Before round 7 the initial staging was
+    charged to NO record and sequential records carried the NEXT round's
+    staging — session totals (``sum(wall_clock_s + data_fn_s +
+    staging_s)``) silently understated by one staging period.
+
     COMPARABILITY NOTE (round 5+): in sequential mode
     (``overlap_staging=False``) the ``data_fn(r+1)`` host shuffle is ALSO
     deferred past the round barrier (previously only staging was serialized
     while the shuffle rode under the in-flight round). Sequential session
-    totals (``sum(wall_clock_s + data_fn_s + staging_s)``) therefore now
-    include the unoverlapped shuffle and are NOT comparable to pre-round-5
-    sequential runs; per-round ``wall_clock_s`` is the intended pure round
-    time either way. Overlap-mode records are unaffected.
+    totals therefore now include the unoverlapped shuffle and are NOT
+    comparable to pre-round-5 sequential runs; per-round ``wall_clock_s``
+    is the intended pure round time either way. Overlap-mode records are
+    unaffected.
     """
 
     round_idx: int
@@ -50,15 +87,24 @@ class RoundRecord:
     # dispatch -> metrics readback. In overlap mode the NEXT round's data_fn
     # and staging ride under the in-flight round, so their host time is
     # EMBEDDED in this wall — summing wall_clock_s + data_fn_s across records
-    # double-counts data_fn. Sum wall_clock_s alone for session time. In
-    # sequential mode (overlap_staging=False) data_fn/staging run after the
-    # round barrier, so wall_clock_s is a pure round time (and the session
-    # total picks up the shuffle separately — see the class docstring).
+    # double-counts data_fn. Sum wall_clock_s alone for session time (plus
+    # the first record's staging_s — the initial transfer precedes the first
+    # dispatch in both modes). In sequential mode (overlap_staging=False)
+    # data_fn/staging run after the round barrier, so wall_clock_s is a pure
+    # round time and the session total picks up shuffle + staging from the
+    # records (see the class docstring).
     wall_clock_s: float
     data_fn_s: float  # host time data_fn spent producing THIS round's data
-    staging_s: float  # sequential-mode next-round staging (0 when overlapped)
+    staging_s: float  # host-blocking staging paid for THIS round's data
     staged_bytes: int  # bytes newly staged for THIS round (0 = buffers reused)
     overlapped: bool  # next round's staging rode under this round's compute
+    # Segmented path only: per-segment host timeline — dispatch time of each
+    # segment program plus the next-round chunk transfer that rode under it
+    # ({"segment", "dispatch_s", "staging_s", "staged_bytes"} per entry).
+    segments: tuple = ()
+    # Peak bytes of driver-staged round data live on the mesh at any point
+    # during this round (current slab + however much of the next had landed).
+    max_live_staged_bytes: int = 0
 
 
 def _barrier_read(x: jax.Array) -> None:
@@ -83,13 +129,148 @@ def stage_round_data(
     — identical byte count, so transfer estimates and ``staged_bytes``
     accounting are unchanged); the default ``P(clients, None, batch)`` spec
     shards the same leading axes either way. Masks always stage
-    full-resolution."""
+    full-resolution. Segment-grain staging calls this once per step-range
+    chunk (``data.pipeline.split_epoch_slab``) — the layout is closed under
+    step-axis slicing."""
     sharding = NamedSharding(mesh, image_spec if image_spec is not None else P(CLIENTS, None, BATCH))
     si = jax.device_put(images, sharding)
     sm = jax.device_put(masks, sharding)
     _barrier_read(si)
     _barrier_read(sm)
     return si, sm
+
+
+def _delete_staged(chunks: Sequence[jax.Array]) -> None:
+    """Release driver-owned staged buffers NOW (not at GC): the segmented
+    path's 2-epoch-slab HBM bound depends on the previous round's chunks
+    dying at the round barrier, not whenever the collector runs."""
+    for a in chunks:
+        try:
+            a.delete()
+        except Exception:
+            pass  # already deleted / backend without explicit delete
+
+
+def _save_round_checkpoint(checkpointer, round_idx, variables, record, history):
+    """Persist the round boundary through ``ckpt.manager.FedCheckpointer``.
+    The device_get is a deliberate barrier — checkpoint cost is NOT
+    overlapped with compute (it runs between rounds, like on_round)."""
+    from fedcrack_tpu.ckpt.manager import FedCheckpoint
+
+    history.append(
+        {
+            "round": round_idx + 1,
+            "wall_clock_s": round(record.wall_clock_s, 3),
+            "loss_mean": float(np.mean(record.metrics["loss"])),
+        }
+    )
+    checkpointer.save(
+        FedCheckpoint(
+            current_round=round_idx + 1,
+            model_version=round_idx + 1,
+            variables=jax.device_get(variables),
+            history=tuple(history),
+        )
+    )
+
+
+def _run_segmented_round(
+    seg: SegmentedRound,
+    variables: Any,
+    si: tuple,
+    sm: tuple,
+    active,
+    n_samples,
+    *,
+    data_fn,
+    round_idx: int,
+    n_rounds: int,
+    overlap_staging: bool,
+    n_chunks: int,
+    mesh: Mesh,
+    spec: P,
+    acct: dict,
+):
+    """One segmented round: K segment dispatches with the NEXT round's slab
+    streaming chunk-by-chunk between them, then the finalize program.
+
+    Mirrors ``SegmentedRound.__call__``'s host loop plus the driver-only
+    concerns — next-round staging, the per-segment host timeline, and the
+    live-staged-bytes accounting (``acct`` is the driver's mutable
+    ``{"live": bytes, "round_max": bytes}``). Returns ``(variables,
+    metrics, out)`` where ``out`` carries the timeline, the (possibly
+    host-viewed) cohort arrays, and the staged next-round state.
+    """
+    out: dict = {
+        "next_buffers": None,
+        "next_cohort": None,
+        "next_bytes": 0,
+        "next_data_s": 0.0,
+    }
+    timeline: list[dict] = []
+    active, n_samples = seg.check_inputs(si, active, n_samples)
+    carry = seg.init(variables)
+    raw_last = None
+    pending: list = []
+    nxt = None
+    for k in range(seg.n_segments):
+        td = time.perf_counter()
+        carry, raw_last = seg.segment(carry, variables, si, sm)
+        entry = {
+            "segment": k,
+            "dispatch_s": round(time.perf_counter() - td, 4),
+        }
+        if overlap_staging and round_idx + 1 < n_rounds:
+            if k == 0:
+                tdd = time.perf_counter()
+                nxt = data_fn(round_idx + 1)
+                out["next_data_s"] = time.perf_counter() - tdd
+                if nxt is not None:
+                    ni, nm, na, nn = nxt
+                    out["next_cohort"] = (na, nn)
+                    out["next_bytes"] = int(ni.nbytes + nm.nbytes)
+                    nic, nmc = split_epoch_slab(ni, nm, n_chunks)
+                    pending = list(zip(nic, nmc))
+                    out["next_buffers"] = ([], [])
+            if pending:
+                # One chunk transfer rides under each in-flight segment
+                # (all of them at k=0 in round-grain mode).
+                take = len(pending) if n_chunks == 1 else 1
+                tss = time.perf_counter()
+                nb = 0
+                for ci, cm in pending[:take]:
+                    s_i, s_m = stage_round_data(ci, cm, mesh, spec)
+                    out["next_buffers"][0].append(s_i)
+                    out["next_buffers"][1].append(s_m)
+                    nb += int(ci.nbytes + cm.nbytes)
+                del pending[:take]
+                acct["live"] += nb
+                acct["round_max"] = max(acct["round_max"], acct["live"])
+                entry["staging_s"] = round(time.perf_counter() - tss, 4)
+                entry["staged_bytes"] = nb
+        timeline.append(entry)
+    # Chunks the segment loop didn't reach (n_chunks was clamped below
+    # n_segments, or data_fn ran long): still overlapped with the in-flight
+    # tail segments + finalize.
+    while pending:
+        ci, cm = pending.pop(0)
+        tss = time.perf_counter()
+        s_i, s_m = stage_round_data(ci, cm, mesh, spec)
+        out["next_buffers"][0].append(s_i)
+        out["next_buffers"][1].append(s_m)
+        acct["live"] += int(ci.nbytes + cm.nbytes)
+        acct["round_max"] = max(acct["round_max"], acct["live"])
+        timeline.append(
+            {
+                "segment": "drain",
+                "staging_s": round(time.perf_counter() - tss, 4),
+                "staged_bytes": int(ci.nbytes + cm.nbytes),
+            }
+        )
+    variables, metrics = seg.finalize(carry, variables, active, n_samples, raw_last)
+    out["timeline"] = timeline
+    out["active"], out["n_samples"] = active, n_samples
+    return variables, metrics, out
 
 
 def run_mesh_federation(
@@ -101,34 +282,60 @@ def run_mesh_federation(
     *,
     image_spec: P | None = None,
     overlap_staging: bool = True,
+    segment_overlap: bool = True,
     on_round: Callable[[RoundRecord, Any], None] | None = None,
+    checkpointer: Any | None = None,
+    start_round: int = 0,
+    history: Sequence[dict] = (),
 ) -> tuple[Any, list[RoundRecord]]:
-    """Drive ``n_rounds`` federated rounds through ``round_fn``.
+    """Drive federated rounds ``start_round .. n_rounds-1`` through
+    ``round_fn``.
 
     - ``round_fn``: a round program from ``build_federated_round`` /
       ``build_spatial_federated_round`` (signature
       ``(variables, images, masks, active, n_samples) -> (variables,
-      metrics)``).
+      metrics)``), or a :class:`~fedcrack_tpu.parallel.fedavg_mesh.
+      SegmentedRound` from ``build_federated_round_segments`` — the driver
+      then runs the segment loop itself so staging can stream between
+      segment dispatches.
     - ``data_fn(r)``: host data for round ``r`` as ``(images, masks,
       active, n_samples)`` numpy arrays, or ``None`` to reuse round
       ``r-1``'s staged buffers and cohort (a client whose local dataset
-      doesn't change between rounds should not re-ship it). ``data_fn(0)``
-      must return data. With ``overlap_staging`` on, ``data_fn(r+1)`` is
-      called while round ``r`` runs on device, so per-round synthesis/
-      shuffle cost also hides under compute; with it off, it is called after
-      round ``r``'s barrier, so sequential timing charges it separately.
+      doesn't change between rounds should not re-ship it).
+      ``data_fn(start_round)`` must return data. With ``overlap_staging``
+      on, ``data_fn(r+1)`` is called while round ``r`` runs on device, so
+      per-round synthesis/shuffle cost also hides under compute; with it
+      off, it is called after round ``r``'s barrier, so sequential timing
+      charges it separately.
     - ``overlap_staging``: stage round r+1 while round r's program runs
       (double buffering). ``False`` serializes staging after the round
       barrier — the two orders produce bit-identical weights (staging is
       data-independent), which the driver's tests pin.
+    - ``segment_overlap`` (segmented rounds only): ``True`` streams the
+      next round's slab as one step-range chunk per segment dispatch
+      (epoch-grain double buffering — no monolithic transfer ever sits on
+      the bus); ``False`` keeps round-grain staging (the full next slab
+      transfers after the first segment dispatch). Ignored for monolithic
+      ``round_fn``s.
     - ``on_round(record, variables)``: per-round hook (metrics sinks,
-      checkpointing, held-out eval). ``variables`` is the round's output
-      pytree, still on device; the hook runs between rounds, so its cost is
-      NOT overlapped with device compute.
+      held-out eval). ``variables`` is the round's output pytree, still on
+      device; the hook runs between rounds, so its cost is NOT overlapped
+      with device compute.
+    - ``checkpointer``: optional ``ckpt.manager.FedCheckpointer``; the
+      driver saves the global variables + history at EVERY round boundary
+      (after ``on_round``). To resume a killed session, restore the
+      checkpoint, pass the restored variables, ``start_round =
+      ckpt.current_round`` and ``history = ckpt.history`` — with a
+      deterministic ``data_fn`` the continued trajectory is identical to
+      the uninterrupted run (test-pinned).
+    - ``start_round``: absolute index of the first round to run (checkpoint
+      resume); ``data_fn`` and ``RoundRecord.round_idx`` use absolute
+      indices throughout.
 
     Returns the final global ``variables`` (on device) and one
-    :class:`RoundRecord` per round. The first round's wall-clock includes
-    XLA compilation; report post-compile medians from ``records[1:]``.
+    :class:`RoundRecord` per executed round. The first round's wall-clock
+    includes XLA compilation; report post-compile medians from
+    ``records[1:]``.
 
     Single-process staging only: ``stage_round_data`` device_puts host
     arrays this process can address in full. A multi-host job stages each
@@ -138,78 +345,161 @@ def run_mesh_federation(
     """
     if n_rounds <= 0:
         raise ValueError(f"n_rounds must be positive, got {n_rounds}")
+    if not 0 <= start_round < n_rounds:
+        raise ValueError(
+            f"start_round={start_round} outside [0, n_rounds={n_rounds})"
+        )
     spec = image_spec if image_spec is not None else P(CLIENTS, None, BATCH)
+    seg = round_fn if isinstance(round_fn, SegmentedRound) else None
+    hist = list(history)
 
     t0 = time.perf_counter()
-    first = data_fn(0)
+    first = data_fn(start_round)
     data_s = time.perf_counter() - t0
     if first is None:
-        raise ValueError("data_fn(0) returned None: the first round has no data")
+        raise ValueError(
+            f"data_fn({start_round}) returned None: the first round has no data"
+        )
     images, masks, active, n_samples = first
-    si, sm = stage_round_data(images, masks, mesh, spec)
+    n_chunks = 1
+    ts = time.perf_counter()
+    if seg is not None:
+        n_chunks = seg.n_segments if segment_overlap else 1
+        ic, mc = split_epoch_slab(images, masks, n_chunks)
+        staged_pairs = [stage_round_data(i, m, mesh, spec) for i, m in zip(ic, mc)]
+        si = tuple(p[0] for p in staged_pairs)
+        sm = tuple(p[1] for p in staged_pairs)
+    else:
+        si, sm = stage_round_data(images, masks, mesh, spec)
+    # Charged to the first executed round's record (boundary-term fix,
+    # round 7): the initial transfer is host-blocking in both modes.
+    pending_staging_s = time.perf_counter() - ts
     staged_bytes = int(images.nbytes + masks.nbytes)
+    cur_bytes = staged_bytes
+    acct = {"live": cur_bytes, "round_max": cur_bytes}
 
     records: list[RoundRecord] = []
-    for r in range(n_rounds):
-        t0 = time.perf_counter()
-        variables, metrics = round_fn(variables, si, sm, active, n_samples)
-
+    for r in range(start_round, n_rounds):
+        acct["round_max"] = acct["live"]
         next_buffers = None
         next_cohort = None
-        next_host = None
+        next_bytes = 0
         next_data_s = 0.0
-        if overlap_staging and r + 1 < n_rounds:
-            # The round program is in flight; data_fn's host work and the
-            # staging transfers ride under it (the barrier inside
-            # stage_round_data only waits for the *transfer*, not the round),
-            # which is why this round's wall embeds them — see RoundRecord.
-            td = time.perf_counter()
-            nxt = data_fn(r + 1)
-            next_data_s = time.perf_counter() - td
-            if nxt is not None:
-                ni, nm, na, nn = nxt
-                next_host = (ni, nm)
-                next_cohort = (na, nn)
-                next_buffers = stage_round_data(ni, nm, mesh, spec)
+        next_staging_s = 0.0
+        timeline: list[dict] = []
+
+        t0 = time.perf_counter()
+        if seg is None:
+            variables, metrics = round_fn(variables, si, sm, active, n_samples)
+
+            if overlap_staging and r + 1 < n_rounds:
+                # The round program is in flight; data_fn's host work and the
+                # staging transfers ride under it (the barrier inside
+                # stage_round_data only waits for the *transfer*, not the
+                # round), which is why this round's wall embeds them — see
+                # RoundRecord.
+                td = time.perf_counter()
+                nxt = data_fn(r + 1)
+                next_data_s = time.perf_counter() - td
+                if nxt is not None:
+                    ni, nm, na, nn = nxt
+                    next_cohort = (na, nn)
+                    next_bytes = int(ni.nbytes + nm.nbytes)
+                    next_buffers = stage_round_data(ni, nm, mesh, spec)
+                    acct["live"] += next_bytes
+                    acct["round_max"] = max(acct["round_max"], acct["live"])
+        else:
+            variables, metrics, segout = _run_segmented_round(
+                seg,
+                variables,
+                si,
+                sm,
+                active,
+                n_samples,
+                data_fn=data_fn,
+                round_idx=r,
+                n_rounds=n_rounds,
+                overlap_staging=overlap_staging,
+                n_chunks=n_chunks,
+                mesh=mesh,
+                spec=spec,
+                acct=acct,
+            )
+            timeline = segout["timeline"]
+            next_buffers = segout["next_buffers"]
+            next_cohort = segout["next_cohort"]
+            next_bytes = segout["next_bytes"]
+            next_data_s = segout["next_data_s"]
+            active, n_samples = segout["active"], segout["n_samples"]
 
         # Round barrier: the metrics depend on every step of every client.
         metrics_host = jax.tree_util.tree_map(np.asarray, metrics)
         wall = time.perf_counter() - t0
 
-        staging_s = 0.0
         if not overlap_staging and r + 1 < n_rounds:
             # Sequential mode: produce AND stage the next round's data after
             # the barrier, so the recorded wall is a pure round time and the
-            # shuffle cost is paid (and accounted) outside it.
+            # shuffle cost is paid (and accounted) outside it. The staging
+            # time is charged to the NEXT round's record (the round that
+            # consumes the data — see the RoundRecord boundary-term note).
             td = time.perf_counter()
             nxt = data_fn(r + 1)
             next_data_s = time.perf_counter() - td
             if nxt is not None:
                 ni, nm, na, nn = nxt
-                next_host = (ni, nm)
                 next_cohort = (na, nn)
+                next_bytes = int(ni.nbytes + nm.nbytes)
                 ts = time.perf_counter()
-                next_buffers = stage_round_data(ni, nm, mesh, spec)
-                staging_s = time.perf_counter() - ts
+                if seg is not None:
+                    nic, nmc = split_epoch_slab(ni, nm, n_chunks)
+                    pairs = [
+                        stage_round_data(ci, cm, mesh, spec)
+                        for ci, cm in zip(nic, nmc)
+                    ]
+                    next_buffers = (
+                        [p[0] for p in pairs],
+                        [p[1] for p in pairs],
+                    )
+                else:
+                    next_buffers = stage_round_data(ni, nm, mesh, spec)
+                next_staging_s = time.perf_counter() - ts
+                acct["live"] += next_bytes
+                acct["round_max"] = max(acct["round_max"], acct["live"])
 
         record = RoundRecord(
             round_idx=r,
             metrics=metrics_host,
             wall_clock_s=wall,
             data_fn_s=data_s,
-            staging_s=staging_s,
+            staging_s=pending_staging_s,
             staged_bytes=staged_bytes,
-            overlapped=overlap_staging and next_host is not None,
+            overlapped=overlap_staging and next_buffers is not None,
+            segments=tuple(timeline),
+            max_live_staged_bytes=acct["round_max"],
         )
         records.append(record)
         if on_round is not None:
             on_round(record, variables)
+        if checkpointer is not None:
+            _save_round_checkpoint(checkpointer, r, variables, record, hist)
 
         data_s = next_data_s
+        pending_staging_s = next_staging_s
         if next_buffers is not None:
-            si, sm = next_buffers
+            # The round barrier above guarantees every consumer of the old
+            # buffers has run; release them NOW so peak staged HBM stays at
+            # ~2 epoch slabs instead of growing until GC.
+            if seg is not None:
+                _delete_staged(tuple(si) + tuple(sm))
+                si = tuple(next_buffers[0])
+                sm = tuple(next_buffers[1])
+            else:
+                _delete_staged((si, sm))
+                si, sm = next_buffers
+            acct["live"] -= cur_bytes
+            cur_bytes = next_bytes
             active, n_samples = next_cohort
-            staged_bytes = int(next_host[0].nbytes + next_host[1].nbytes)
+            staged_bytes = next_bytes
         else:
             staged_bytes = 0
 

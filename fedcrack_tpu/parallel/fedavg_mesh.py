@@ -23,7 +23,8 @@ invariant to how a client's batch is split across its DP shards — the
 
 from __future__ import annotations
 
-from typing import Any, Sequence
+import dataclasses
+from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -63,6 +64,123 @@ def _host_view(x) -> np.ndarray | None:
     if isinstance(x, jax.Array) and not x.is_fully_addressable:
         return None
     return np.asarray(x, np.float32)
+
+
+def _epoch_runner(tx, apply_fn, inner_axis, n_inner, anchor, mu_arr, pw_arr):
+    """The per-client local-fit core, shared OP FOR OP by the monolithic
+    round (``_build_round``) and the epoch-segmented variant
+    (``_build_round_segments``): returns ``run_epochs(carry, chunks,
+    n_epochs)`` scanning ``sgd_step`` over each step-axis data chunk in
+    order (carry threaded across chunks) inside an outer epoch scan.
+
+    Sharing this closure is what makes "segmented == monolithic, byte for
+    byte" hold by construction rather than by parallel maintenance: a
+    single-chunk call is exactly the historical monolithic epoch body, and
+    splitting one scan into consecutive scans with the carry threaded
+    through is the identical step sequence (test-pinned).
+    """
+
+    def sgd_step(carry, batch):
+        params, batch_stats, opt_state = carry
+        # Accept uint8 transport bytes (1/4 the staging traffic); the
+        # on-device normalization reproduces float32 staging values
+        # bit for bit (data.pipeline.as_model_batch).
+        imgs, msks = as_model_batch(*batch)
+
+        def loss_fn(p):
+            logits, new_stats = apply_fn(p, batch_stats, imgs)
+            # One fused pass for BCE + all statistics (Pallas kernel on
+            # TPU, XLA reference elsewhere — ops/pallas_bce.py).
+            m = fused_segmentation_metrics(logits, msks, pos_weight=pw_arr)
+            prox = fedprox_penalty(p, anchor, mu_arr)
+            return m["loss"] + prox, (m, new_stats)
+
+        (loss, (m, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
+        # `params` is unvarying over the inner axis, so shard_map's AD
+        # already psums the per-shard cotangents; dividing by the shard
+        # count turns that sum of local-mean gradients into the gradient
+        # of the client's full mean loss (a pmean here would be an
+        # identity on the already-summed value and double-count).
+        # Pre-vma JAX performs NO such AD psum — jaxcompat inserts the
+        # equivalent explicit one there (identity on current JAX).
+        # CAUTION: that AD-inserted psum spans ONLY the inner axis — not
+        # the clients axis — solely because the lax.scan carry makes
+        # params clients-VARYING after step one (carry-vma unification
+        # promotes the whole carry; in the segmented variant the carry
+        # arrives already clients-sharded, the same varying state). For
+        # fully replicated params the AD psum spans ALL mesh axes
+        # (spatial.py's scan-free step divides by the product of both
+        # axis sizes for exactly that reason). If this round is ever
+        # restructured without the scan, the divisor must change;
+        # test_dp_gradient_not_double_counted pins the current behavior.
+        grads = psum_if_no_auto(grads, (inner_axis,))
+        grads = jax.tree_util.tree_map(lambda g: g / n_inner, grads)
+        # BN moments are already pmean-synced inside the forward; this
+        # keeps the carried stats bitwise identical across inner shards.
+        new_stats = lax.pmean(new_stats, inner_axis)
+        updates, new_opt_state = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        metrics = {
+            "loss": lax.pmean(loss, inner_axis),
+            "pixel_acc": lax.pmean(m["pixel_acc"], inner_axis),
+            "iou_inter": lax.psum(m["iou_inter"], inner_axis),
+            "iou_union": lax.psum(m["iou_union"], inner_axis),
+        }
+        return (new_params, new_stats, new_opt_state), metrics
+
+    def run_epochs(carry, chunks, n_epochs):
+        def epoch_body(carry, _):
+            parts = []
+            for imgs, msks in chunks:
+                carry, part = lax.scan(sgd_step, carry, (imgs, msks))
+                parts.append(part)
+            # Single-chunk (monolithic) keeps the historical graph exactly;
+            # multi-chunk concatenates the stacked per-step metrics back
+            # into one [steps] axis so the epoch reductions below see the
+            # same array a monolithic scan would have produced.
+            step_metrics = (
+                parts[0]
+                if len(parts) == 1
+                else jax.tree_util.tree_map(
+                    lambda *xs: jnp.concatenate(xs), *parts
+                )
+            )
+            epoch_metrics = {
+                "loss": jnp.mean(step_metrics["loss"]),
+                "pixel_acc": jnp.mean(step_metrics["pixel_acc"]),
+                "iou_inter": jnp.sum(step_metrics["iou_inter"]),
+                "iou_union": jnp.sum(step_metrics["iou_union"]),
+            }
+            return carry, epoch_metrics
+
+        return lax.scan(epoch_body, carry, None, length=n_epochs)
+
+    return run_epochs
+
+
+def _aggregate_and_guard(
+    params, batch_stats, fallback_params, fallback_stats, active_i, n_i
+):
+    """Masked sample-weighted FedAvg over the clients axis (ICI psum), with
+    the in-mesh empty-cohort guard: when every client dropped out the masked
+    mean is all-zeros — return the round's incoming global model unchanged
+    instead. Shared by the monolithic round's tail and the segmented
+    variant's finalize program (same ops, same order)."""
+    w = active_i * n_i
+    total_w = lax.psum(w, CLIENTS)
+    denom = jnp.maximum(total_w, 1e-9)
+    averaged = {
+        "params": _masked_mean_over_clients(params, w, denom),
+        "batch_stats": _masked_mean_over_clients(batch_stats, w, denom),
+    }
+    keep = total_w > 0.0
+    return jax.tree_util.tree_map(
+        lambda avg, orig: jnp.where(keep, avg, orig.astype(avg.dtype)),
+        averaged,
+        {"params": fallback_params, "batch_stats": fallback_stats},
+    )
 
 
 def _require_axes(mesh: Mesh, *axes: str) -> None:
@@ -125,65 +243,9 @@ def _build_round(
         mu_arr = jnp.asarray(mu, jnp.float32)
         pw_arr = jnp.asarray(pw, jnp.float32)
 
-        def sgd_step(carry, batch):
-            params, batch_stats, opt_state = carry
-            # Accept uint8 transport bytes (1/4 the staging traffic); the
-            # on-device normalization reproduces float32 staging values
-            # bit for bit (data.pipeline.as_model_batch).
-            imgs, msks = as_model_batch(*batch)
-
-            def loss_fn(p):
-                logits, new_stats = apply_fn(p, batch_stats, imgs)
-                # One fused pass for BCE + all statistics (Pallas kernel on
-                # TPU, XLA reference elsewhere — ops/pallas_bce.py).
-                m = fused_segmentation_metrics(logits, msks, pos_weight=pw_arr)
-                prox = fedprox_penalty(p, anchor, mu_arr)
-                return m["loss"] + prox, (m, new_stats)
-
-            (loss, (m, new_stats)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params)
-            # `params` is unvarying over the inner axis, so shard_map's AD
-            # already psums the per-shard cotangents; dividing by the shard
-            # count turns that sum of local-mean gradients into the gradient
-            # of the client's full mean loss (a pmean here would be an
-            # identity on the already-summed value and double-count).
-            # Pre-vma JAX performs NO such AD psum — jaxcompat inserts the
-            # equivalent explicit one there (identity on current JAX).
-            # CAUTION: that AD-inserted psum spans ONLY the inner axis — not
-            # the clients axis — solely because the lax.scan carry makes
-            # params clients-VARYING after step one (carry-vma unification
-            # promotes the whole carry). For fully replicated params the
-            # AD psum spans ALL mesh axes (spatial.py's scan-free step
-            # divides by the product of both axis sizes for exactly that
-            # reason). If this round is ever restructured without the scan,
-            # the divisor must change; test_dp_gradient_not_double_counted
-            # pins the current behavior.
-            grads = psum_if_no_auto(grads, (inner_axis,))
-            grads = jax.tree_util.tree_map(lambda g: g / n_inner, grads)
-            # BN moments are already pmean-synced inside the forward; this
-            # keeps the carried stats bitwise identical across inner shards.
-            new_stats = lax.pmean(new_stats, inner_axis)
-            updates, new_opt_state = tx.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
-            metrics = {
-                "loss": lax.pmean(loss, inner_axis),
-                "pixel_acc": lax.pmean(m["pixel_acc"], inner_axis),
-                "iou_inter": lax.psum(m["iou_inter"], inner_axis),
-                "iou_union": lax.psum(m["iou_union"], inner_axis),
-            }
-            return (new_params, new_stats, new_opt_state), metrics
-
-        def epoch_body(carry, _):
-            carry, step_metrics = lax.scan(sgd_step, carry, (images, masks))
-            epoch_metrics = {
-                "loss": jnp.mean(step_metrics["loss"]),
-                "pixel_acc": jnp.mean(step_metrics["pixel_acc"]),
-                "iou_inter": jnp.sum(step_metrics["iou_inter"]),
-                "iou_union": jnp.sum(step_metrics["iou_union"]),
-            }
-            return carry, epoch_metrics
-
+        run_epochs = _epoch_runner(
+            tx, apply_fn, inner_axis, n_inner, anchor, mu_arr, pw_arr
+        )
         # The carry becomes client-varying after the first data-dependent
         # update; promote the (replicated) initial carry so scan's carry type
         # is stable under shard_map's varying-axes tracking.
@@ -191,29 +253,18 @@ def _build_round(
             lambda x: pcast_varying(x, (CLIENTS,)),
             (params, batch_stats, opt_state),
         )
-        carry, per_epoch = lax.scan(
-            epoch_body, carry, None, length=max(1, local_epochs)
+        carry, per_epoch = run_epochs(
+            carry, [(images, masks)], max(1, local_epochs)
         )
         params, batch_stats, _ = carry
 
-        # Masked sample-weighted FedAvg over the clients axis (ICI psum).
-        w = active_i * n_i
-        total_w = lax.psum(w, CLIENTS)
-        denom = jnp.maximum(total_w, 1e-9)
-        averaged = {
-            "params": _masked_mean_over_clients(params, w, denom),
-            "batch_stats": _masked_mean_over_clients(batch_stats, w, denom),
-        }
-        # Empty-cohort guard, enforced IN-MESH: when every client dropped out
-        # the masked mean above is all-zeros — return the round's incoming
-        # global model unchanged instead. The host-side ValueError still fires
-        # where the mask is host-visible; this covers multi-host jobs whose
-        # sharded mask no single process can inspect.
-        keep = total_w > 0.0
-        new_variables = jax.tree_util.tree_map(
-            lambda avg, orig: jnp.where(keep, avg, orig.astype(avg.dtype)),
-            averaged,
-            {"params": anchor, "batch_stats": variables["batch_stats"]},
+        new_variables = _aggregate_and_guard(
+            params,
+            batch_stats,
+            anchor,
+            variables["batch_stats"],
+            active_i,
+            n_i,
         )
 
         last = jax.tree_util.tree_map(lambda a: a[-1], per_epoch)
@@ -249,18 +300,55 @@ def _build_round(
         # global value THIS process cannot fetch — the check then happens
         # in-mesh instead (all-dropout returns the incoming global model
         # unchanged; see the `keep` guard in client_fit).
-        active_h, n_samples_h = _host_view(active), _host_view(n_samples)
-        if active_h is not None and n_samples_h is not None:
-            if float(np.sum(active_h * n_samples_h)) <= 0.0:
-                raise ValueError(
-                    "non-positive total FedAvg weight: every client dropped "
-                    f"out (active={active_h.tolist()}, "
-                    f"n_samples={n_samples_h.tolist()})"
-                )
-            active, n_samples = active_h, n_samples_h
+        active, n_samples = _host_cohort_check(active, n_samples)
         return jitted(variables, images, masks, active, n_samples)
 
     return round_fn
+
+
+def _host_cohort_check(active, n_samples):
+    """Raise on an all-dropped cohort where the mask is host-visible; return
+    host float32 views when fetchable (multi-host sharded masks pass through
+    untouched — the in-mesh ``keep`` guard covers them)."""
+    active_h, n_samples_h = _host_view(active), _host_view(n_samples)
+    if active_h is not None and n_samples_h is not None:
+        if float(np.sum(active_h * n_samples_h)) <= 0.0:
+            raise ValueError(
+                "non-positive total FedAvg weight: every client dropped "
+                f"out (active={active_h.tolist()}, "
+                f"n_samples={n_samples_h.tolist()})"
+            )
+        return active_h, n_samples_h
+    return active, n_samples
+
+
+def _plain_apply_and_validate(model_config: ModelConfig):
+    """The plain (sync-BN-over-batch) forward + staging-layout validator,
+    shared by the monolithic and segmented round builders."""
+    model = ResUNet(config=model_config, bn_axis_name=BATCH)
+    in_ch = model_config.in_channels
+    packed_ok = model_config.stem_layout != "reference"
+
+    def validate_channels(images) -> None:
+        ch = images.shape[-1]
+        allowed = (in_ch, 4 * in_ch) if packed_ok else (in_ch,)
+        if ch not in allowed:
+            raise ValueError(
+                f"images carry {ch} channels; stem_layout="
+                f"{model_config.stem_layout!r} accepts {allowed} "
+                "(4x = space_to_depth-packed staging)"
+            )
+
+    def apply_fn(params, batch_stats, imgs):
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            imgs,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        return logits, mutated["batch_stats"]
+
+    return apply_fn, validate_channels
 
 
 def build_federated_round(
@@ -301,29 +389,7 @@ def build_federated_round(
     """
     model_config = model_config or ModelConfig()
     _require_axes(mesh, CLIENTS, BATCH)
-    model = ResUNet(config=model_config, bn_axis_name=BATCH)
-    in_ch = model_config.in_channels
-    packed_ok = model_config.stem_layout != "reference"
-
-    def validate_channels(images) -> None:
-        ch = images.shape[-1]
-        allowed = (in_ch, 4 * in_ch) if packed_ok else (in_ch,)
-        if ch not in allowed:
-            raise ValueError(
-                f"images carry {ch} channels; stem_layout="
-                f"{model_config.stem_layout!r} accepts {allowed} "
-                "(4x = space_to_depth-packed staging)"
-            )
-
-    def apply_fn(params, batch_stats, imgs):
-        logits, mutated = model.apply(
-            {"params": params, "batch_stats": batch_stats},
-            imgs,
-            train=True,
-            mutable=["batch_stats"],
-        )
-        return logits, mutated["batch_stats"]
-
+    apply_fn, validate_channels = _plain_apply_and_validate(model_config)
     return _build_round(
         mesh,
         model_config,
@@ -336,6 +402,274 @@ def build_federated_round(
         validate_data=validate_channels,
         pos_weight=pos_weight,
         remat=remat,
+    )
+
+
+def _as_chunks(x) -> tuple:
+    """Normalize a round data argument to a tuple of step-axis chunks: a
+    single ``[C, steps, B, ...]`` array is one chunk; a tuple/list of such
+    arrays is consumed as consecutive step ranges (their concatenation
+    along axis 1 is the monolithic layout)."""
+    if isinstance(x, (tuple, list)):
+        if not x:
+            raise ValueError("empty chunk list for round data")
+        return tuple(x)
+    return (x,)
+
+
+@dataclasses.dataclass(frozen=True)
+class SegmentedRound:
+    """An epoch-segmented federated round: K device-resident-carry segment
+    programs instead of one monolithic K*epochs-steps scan.
+
+    The monolithic round (``build_federated_round``) compiles the whole
+    ``local_epochs x steps`` trajectory plus FedAvg into ONE XLA program —
+    great for dispatch overhead, but it forces round-grain staging (the
+    full epoch slab must land before any step runs), caps staging/compute
+    overlap at round grain, and at 256 px the 3,880-step program is too
+    large for some remote-compile paths (VERDICT r5 #6). This variant
+    splits the trajectory into ``n_segments`` programs of
+    ``segment_epochs`` epochs each; the per-client ``(params, batch_stats,
+    opt_state)`` carry stays ON DEVICE between segments as a
+    ``P('clients')``-sharded pytree and is DONATED to the next segment
+    call, so the split costs K-1 extra dispatches and zero extra HBM.
+
+    Byte-exactness contract (test-pinned): for any K dividing
+    ``local_epochs`` — and any step-axis chunking of the data — the final
+    global weights AND the returned metrics are bit-identical to the
+    monolithic round on the same inputs. The segment body is the SAME
+    closure the monolithic round traces (``_epoch_runner``), the carry
+    crosses program boundaries as pure data movement, and the finalize
+    program runs the same masked-psum FedAvg tail.
+
+    Calling the object is round_fn-compatible
+    (``(variables, images, masks, active, n_samples) -> (new_variables,
+    metrics)``, with ``images``/``masks`` each either one array or a tuple
+    of step-axis chunks); ``parallel.driver.run_mesh_federation`` instead
+    drives ``init``/``segment``/``finalize`` itself so next-round staging
+    can stream at segment grain between dispatches.
+    """
+
+    n_segments: int
+    segment_epochs: int
+    local_epochs: int
+    n_client_shards: int
+    init_fn: Callable = dataclasses.field(repr=False)
+    segment_fn: Callable = dataclasses.field(repr=False)
+    finalize_fn: Callable = dataclasses.field(repr=False)
+    validate_data: Callable = dataclasses.field(repr=False)
+
+    def check_inputs(self, img_chunks: tuple, active, n_samples):
+        """Host-side validation mirroring the monolithic ``round_fn``;
+        returns the (possibly host-viewed) cohort arrays."""
+        for c in img_chunks:
+            if c.shape[0] != self.n_client_shards:
+                raise ValueError(
+                    f"data carries {c.shape[0]} clients, mesh has "
+                    f"{self.n_client_shards} on the '{CLIENTS}' axis"
+                )
+        self.validate_data(img_chunks[0])
+        return _host_cohort_check(active, n_samples)
+
+    def init(self, variables):
+        """Fresh per-client carry from the round's global variables (Adam
+        state zeroed — the reference rebuilds its model per round)."""
+        return self.init_fn(variables)
+
+    def segment(self, carry, variables, img_chunks, msk_chunks):
+        """Run one segment (``segment_epochs`` epochs over all chunks).
+        ``carry`` is DONATED — the caller must thread the returned carry
+        and never reuse the argument. Returns ``(carry, raw_last)`` where
+        ``raw_last`` is the segment's last-epoch metric counts ([C] each).
+        """
+        return self.segment_fn(
+            carry, variables, _as_chunks(img_chunks), _as_chunks(msk_chunks)
+        )
+
+    def finalize(self, carry, variables, active, n_samples, raw_last):
+        """Masked FedAvg over the clients axis plus the monolithic round's
+        metrics dict from the last segment's counts."""
+        # jnp.asarray (not np.asarray): a multi-host cohort mask arrives as
+        # a cross-process sharded jax.Array that no single process can
+        # fetch to host — the same passthrough contract the monolithic
+        # round_fn honors (_host_cohort_check returns it untouched and the
+        # in-mesh `keep` guard covers the empty-cohort case).
+        active32 = jnp.asarray(active, jnp.float32)
+        n32 = jnp.asarray(n_samples, jnp.float32)
+        new_variables = self.finalize_fn(carry, variables, active32, n32)
+        metrics = {
+            "loss": raw_last["loss"],
+            "pixel_acc": raw_last["pixel_acc"],
+            "iou": iou_from_counts(raw_last["iou_inter"], raw_last["iou_union"]),
+            "active": active32,
+        }
+        return new_variables, metrics
+
+    def __call__(self, variables, images, masks, active, n_samples):
+        img_chunks, msk_chunks = _as_chunks(images), _as_chunks(masks)
+        active, n_samples = self.check_inputs(img_chunks, active, n_samples)
+        carry = self.init(variables)
+        raw_last = None
+        for _ in range(self.n_segments):
+            carry, raw_last = self.segment(carry, variables, img_chunks, msk_chunks)
+        return self.finalize(carry, variables, active, n_samples, raw_last)
+
+
+def _build_round_segments(
+    mesh: Mesh,
+    model_config: ModelConfig,
+    learning_rate: float,
+    local_epochs: int,
+    fedprox_mu: float,
+    *,
+    inner_axis: str,
+    apply_fn,
+    image_spec: P,
+    validate_data,
+    pos_weight: float = 1.0,
+    remat: bool = False,
+    segments: int = 0,
+) -> SegmentedRound:
+    """Segmented twin of ``_build_round`` (same skeleton, same shared
+    ``_epoch_runner``/``_aggregate_and_guard`` closures — see
+    :class:`SegmentedRound` for the exactness contract)."""
+    tx = make_optimizer(learning_rate)
+    mu = float(fedprox_mu)
+    pw = float(pos_weight)
+    if remat:
+        apply_fn = jax.checkpoint(apply_fn, prevent_cse=False)
+    n_client_shards = mesh.shape[CLIENTS]
+    n_inner = mesh.shape[inner_axis]
+    epochs = max(1, local_epochs)
+    n_segments = epochs if not segments else int(segments)
+    if n_segments <= 0 or epochs % n_segments:
+        raise ValueError(
+            f"segments={segments!r} must be a positive divisor of "
+            f"local_epochs={epochs} (epoch-grain segmentation)"
+        )
+    segment_epochs = epochs // n_segments
+
+    def init_shard(variables):
+        params = variables["params"]
+        opt_state = tx.init(params)
+        # Same promotion as the monolithic round's initial carry: the carry
+        # is client-varying from the first data-dependent update on, and
+        # here it must leave the program through a P('clients') out_spec.
+        carry = jax.tree_util.tree_map(
+            lambda x: pcast_varying(x, (CLIENTS,)),
+            (params, variables["batch_stats"], opt_state),
+        )
+        return jax.tree_util.tree_map(lambda x: x[None], carry)
+
+    init_fn = jax.jit(
+        shard_map(init_shard, mesh=mesh, in_specs=(P(),), out_specs=P(CLIENTS))
+    )
+
+    def segment_shard(carry, variables, img_chunks, msk_chunks):
+        carry = jax.tree_util.tree_map(lambda x: x[0], carry)
+        anchor = variables["params"]  # FedProx anchor = round-start globals
+        mu_arr = jnp.asarray(mu, jnp.float32)
+        pw_arr = jnp.asarray(pw, jnp.float32)
+        run_epochs = _epoch_runner(
+            tx, apply_fn, inner_axis, n_inner, anchor, mu_arr, pw_arr
+        )
+        chunks = [(i[0], m[0]) for i, m in zip(img_chunks, msk_chunks)]
+        carry, per_epoch = run_epochs(carry, chunks, segment_epochs)
+        last = jax.tree_util.tree_map(lambda a: a[-1], per_epoch)
+        return (
+            jax.tree_util.tree_map(lambda x: x[None], carry),
+            jax.tree_util.tree_map(lambda a: a[None], last),
+        )
+
+    segment_fn = jax.jit(
+        shard_map(
+            segment_shard,
+            mesh=mesh,
+            in_specs=(P(CLIENTS), P(), image_spec, image_spec),
+            out_specs=(P(CLIENTS), P(CLIENTS)),
+        ),
+        # The previous segment's carry buffers back the next segment's: the
+        # split adds zero steady-state HBM over the monolithic scan.
+        donate_argnums=(0,),
+    )
+
+    def finalize_shard(carry, variables, active, n_samples):
+        params, batch_stats, _ = jax.tree_util.tree_map(lambda x: x[0], carry)
+        return _aggregate_and_guard(
+            params,
+            batch_stats,
+            variables["params"],
+            variables["batch_stats"],
+            active[0],
+            n_samples[0],
+        )
+
+    # No donation here: the finalize outputs (the replicated averaged tree)
+    # cannot alias the clients-sharded carry blocks, so donating would only
+    # emit "donated buffers were not usable" warnings; the carry dies by
+    # refcount right after this call anyway.
+    finalize_fn = jax.jit(
+        shard_map(
+            finalize_shard,
+            mesh=mesh,
+            in_specs=(P(CLIENTS), P(), P(CLIENTS), P(CLIENTS)),
+            out_specs=P(),
+        )
+    )
+
+    return SegmentedRound(
+        n_segments=n_segments,
+        segment_epochs=segment_epochs,
+        local_epochs=epochs,
+        n_client_shards=n_client_shards,
+        init_fn=init_fn,
+        segment_fn=segment_fn,
+        finalize_fn=finalize_fn,
+        validate_data=validate_data,
+    )
+
+
+def build_federated_round_segments(
+    mesh: Mesh,
+    model_config: ModelConfig | None = None,
+    learning_rate: float = 1e-3,
+    local_epochs: int = 1,
+    fedprox_mu: float = 0.0,
+    pos_weight: float = 1.0,
+    remat: bool = False,
+    segments: int = 0,
+) -> SegmentedRound:
+    """Epoch-segmented variant of :func:`build_federated_round`.
+
+    Same data contract and semantics; ``segments`` (default 0 = one
+    segment per local epoch) must divide ``local_epochs``. ``segments=1``
+    still differs from the monolithic builder operationally — the carry
+    crosses one program boundary and FedAvg runs as a separate finalize
+    program — but the result is bit-identical (test-pinned), which makes
+    K=1 the cheap cross-check of the whole mechanism.
+
+    Why segment: staging can stream at segment grain under the in-flight
+    segments (``parallel.driver``), each compiled program is
+    ``1/n_segments`` the size (the 256 px reference-scale round compiles
+    as 10 x 388-step programs where the 3,880-step monolith fails —
+    VERDICT r5 #6), and carry donation keeps the split HBM-neutral.
+    """
+    model_config = model_config or ModelConfig()
+    _require_axes(mesh, CLIENTS, BATCH)
+    apply_fn, validate_channels = _plain_apply_and_validate(model_config)
+    return _build_round_segments(
+        mesh,
+        model_config,
+        learning_rate,
+        local_epochs,
+        fedprox_mu,
+        inner_axis=BATCH,
+        apply_fn=apply_fn,
+        image_spec=P(CLIENTS, None, BATCH),
+        validate_data=validate_channels,
+        pos_weight=pos_weight,
+        remat=remat,
+        segments=segments,
     )
 
 

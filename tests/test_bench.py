@@ -123,3 +123,100 @@ def test_bench_budget_skips_sections_but_still_emits():
     assert "layout_ab_float32_32" in skipped
     assert skipped["sweep_48"]["reason"] == "estimate exceeds remaining budget"
     assert detail["budget"]["budget_s"] == 1.0
+
+
+# ---- tier-1-safe schema guards (round 7): artifact consumers key on these
+# detail names; a rename must break CI here, not silently break dashboards
+# and BASELINE.md updates downstream. No bench run needed — the module's
+# declared schema is checked against its own emitting code and against the
+# committed bench_runs/ artifacts. ----
+
+
+def _import_bench():
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_module", os.path.join(root, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_detail_schema_declares_contract_keys():
+    bench = _import_bench()
+    required = {
+        "sweep",
+        "skipped",
+        "budget",
+        "reference_scale",
+        "layout_ab",
+        "segmented_pipeline",
+    }
+    assert required <= set(bench.DETAIL_SCHEMA)
+    assert {"round_ms", "round_plus_restage_ms", "staging_hidden_frac"} <= set(
+        bench.REF_POINT_SCHEMA
+    )
+    # The schema cannot drift from the code that writes the payload: every
+    # declared key must appear as a literal in bench.py's emitting code.
+    with open(bench.__file__) as f:
+        src = f.read()
+    for key in required | set(bench.REF_POINT_SCHEMA):
+        assert f'"{key}"' in src, f"schema key {key!r} never written by bench.py"
+
+
+def test_validate_detail_typed_checks():
+    bench = _import_bench()
+    good = {
+        "sweep": {"bfloat16_32": {}},
+        "skipped": [],
+        "budget": {"budget_s": 1.0},
+        "reference_scale": {
+            "bfloat16_128": {
+                "round_ms": 7400.0,
+                "round_plus_restage_ms": 20336.0,
+                "staging_hidden_frac": 0.231,
+            }
+        },
+        "segmented_pipeline": {
+            "bfloat16_128": {
+                "monolithic": {"round_ms": 7400.0, "staging_hidden_frac": 0.2},
+                "segmented": {"round_ms": 7500.0, "staging_hidden_frac": None},
+            }
+        },
+    }
+    assert bench.validate_detail(good) == []
+    assert bench.validate_detail({}) == []  # every section is optional
+    bad = dict(good, skipped="oops")
+    assert any("skipped" in v for v in bench.validate_detail(bad))
+    bad2 = dict(
+        good,
+        reference_scale={"x": {"staging_hidden_frac": "0.2"}},
+    )
+    assert any("staging_hidden_frac" in v for v in bench.validate_detail(bad2))
+
+
+def test_committed_bench_artifacts_satisfy_schema():
+    """Every committed bench_runs/ artifact that carries a detail payload
+    must validate against the declared schema — the contract holds
+    retroactively, so consumers can parse any round's artifact."""
+    bench = _import_bench()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    run_dir = os.path.join(root, "bench_runs")
+    checked = 0
+    for name in sorted(os.listdir(run_dir)):
+        if not name.endswith(".json"):
+            continue
+        with open(os.path.join(run_dir, name)) as f:
+            try:
+                art = json.load(f)
+            except ValueError:
+                continue
+        detail = art.get("detail") if isinstance(art, dict) else None
+        if not isinstance(detail, dict):
+            continue
+        bad = bench.validate_detail(detail)
+        assert not bad, f"{name}: {bad}"
+        checked += 1
+    assert checked >= 1, "no bench artifacts found to validate"
