@@ -76,7 +76,9 @@ FEDCRACK_BENCH_REF_SCALE=auto|1|0 FEDCRACK_BENCH_REF_EPOCHS=10
 FEDCRACK_BENCH_REF_STEPS=388 FEDCRACK_BENCH_REF_256=1 (opt-in: the ~10 min
 bf16/256 reference-scale point) FEDCRACK_PEAK_TFLOPS=<override chip peak>
 FEDCRACK_BENCH_LAYOUTS=reference,s2d,s2d_full,respack,s2d+respack (layout
-A/B variants; first is the ratio denominator).
+A/B variants; first is the ratio denominator)
+FEDCRACK_BENCH_CHAOS=0 (skip the mid-round kill→restart recovery drill,
+detail.chaos_recovery).
 """
 
 from __future__ import annotations
@@ -127,6 +129,7 @@ DETAIL_SCHEMA: dict = {
     "host_plane": dict,
     "batch_curve": dict,
     "input_pipeline": dict,
+    "chaos_recovery": dict,
 }
 # Per-point keys of detail.reference_scale.* and the per-arm dicts of
 # detail.segmented_pipeline.*: the staging/overlap decomposition contract.
@@ -174,6 +177,11 @@ _START = time.monotonic()
 # round 4's first budget cut assumed warm and blew a wall-clock timeout
 # inside the 256 sweep instead of skipping it.
 COMPILE_EST_S = 60.0
+
+# Mid-round kill→restart recovery drill (tools/chaos_drill): host-only,
+# tiny weights, seconds — times the durable-statefile crash-recovery path
+# (round 8). "0" opts out.
+CHAOS = os.environ.get("FEDCRACK_BENCH_CHAOS", "1") == "1"
 
 # Longer-round multiplier for the dispatch-correction fit; the two-point
 # slope needs the rounds to differ, so 2 is the floor.
@@ -1655,6 +1663,25 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
             )
         detail["budget"] = _budget_detail()
         _set_payload(metric_headline, value, vs_baseline, detail)
+
+    # ---- chaos recovery: the mid-round server kill→restart drill (host-only
+    # control plane, tiny weights, seconds — times the round-8 durable-
+    # statefile crash-recovery path; semantics are pinned by the tier-1
+    # chaos suite, this section contributes the TIMING artifact) ----
+    if CHAOS:
+        if _fits(15.0):
+            t0 = time.monotonic()
+            try:
+                from fedcrack_tpu.tools.chaos_drill import run_kill_restart_drill
+
+                detail["chaos_recovery"] = run_kill_restart_drill()
+            except Exception as e:  # a host-only extra must never kill the artifact
+                detail["chaos_recovery"] = {"error": repr(e)}
+            section_s["chaos_recovery"] = time.monotonic() - t0
+            detail["budget"] = _budget_detail()
+            _set_payload(metric_headline, value, vs_baseline, detail)
+        else:
+            _skip(skips, "chaos_recovery", 15.0, "estimate exceeds remaining budget")
 
     # ---- batch-scaling curve (bf16 flagship at batch 32/64; non-parity
     # appendix substantiating the width-bound-ceiling claim) ----

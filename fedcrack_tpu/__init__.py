@@ -18,8 +18,13 @@ Built from scratch in JAX/Flax/XLA with the capabilities of the reference
                   masked psum FedAvg), intra-client batch DP, spatial context
                   parallelism with halo exchange, multi-host bring-up.
 - ``obs``       — structured JSONL metrics, TensorBoard export, FLOPs/MFU.
-- ``ckpt``      — orbax checkpoint/resume for the coordinator.
-- ``tools``     — Keras h5 weight import, crack quantification.
+- ``ckpt``      — orbax checkpoint/resume for the coordinator, plus the
+                  mid-round durable statefile (crash-recoverable rounds).
+- ``chaos``     — deterministic fault injection for both planes: seeded
+                  FaultPlans hooked into the transport client and the mesh
+                  driver (tests/test_chaos.py is the scenario suite).
+- ``tools``     — Keras h5 weight import, crack quantification, the
+                  kill→restart recovery drill (chaos_drill).
 - ``native``    — first-party C++ host runtime (resize/binarize, CRC32C).
 
 See SURVEY.md §7 for the full build plan this package follows and PARITY.md

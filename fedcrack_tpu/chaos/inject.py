@@ -1,0 +1,199 @@
+"""Fault-plan hooks for the transport client and the mesh driver.
+
+Injection points, all zero-cost when no plan is attached:
+
+- ``ClientChaos`` plugs into :class:`fedcrack_tpu.transport.client.FedClient`
+  (``chaos=`` ctor arg). ``before_send`` runs inside the retry loop right
+  before each RPC; ``after_reply`` runs on the reply before it is returned.
+  Between them they express every client-side fault: crashes before/during/
+  after the weight upload, straggler sleeps, transient UNAVAILABLE flaps
+  (which must be survived by the retry schedule), and the four payload
+  poisonings (corrupt / truncate / NaN / stale-round replay) that the
+  server's update sanitation must catch.
+- ``MeshChaos`` is a ``fault_injector`` for
+  :func:`fedcrack_tpu.parallel.driver.run_mesh_federation`: called as
+  ``injector(round_idx, attempt)`` before each round attempt, it either
+  raises :class:`InjectedDeviceFailure` (preemption) or returns a transform
+  that poisons the round output with NaNs (silent numerical corruption).
+
+Server kill-and-restart is deliberately NOT a hook: a dead process cannot
+run one. The harnesses (tests/test_chaos.py, tools/chaos_drill.py) kill the
+serving loop itself and boot a fresh ``FedServer`` over the same state
+directory — the recovery path under test is the statefile restore, not an
+in-process simulation of it.
+
+Injected crashes surface as :class:`InjectedCrash` — an ordinary exception
+escaping the client session, exactly like the trainer exceptions real client
+deaths produce in the existing fault tests.
+"""
+
+from __future__ import annotations
+
+import time
+
+import grpc
+
+from fedcrack_tpu.chaos.plan import (
+    CRASH_AFTER_UPLOAD,
+    CRASH_BEFORE_UPLOAD,
+    CRASH_DURING_UPLOAD,
+    CORRUPT_PAYLOAD,
+    MESH_DEVICE_FAIL,
+    MESH_NONFINITE,
+    NAN_UPDATE,
+    NETWORK_FLAP,
+    STALE_REPLAY,
+    STRAGGLER_DELAY,
+    TRUNCATE_PAYLOAD,
+    FaultPlan,
+)
+
+
+class InjectedCrash(Exception):
+    """The planned death of a client process (raised out of the session)."""
+
+
+class InjectedDeviceFailure(Exception):
+    """A planned mesh-plane device/host loss (raised out of the round)."""
+
+
+class InjectedRpcError(grpc.RpcError):
+    """A synthetic transient transport failure. Carries UNAVAILABLE — the
+    code real gRPC raises for a flapping network — so the client's
+    retryable/non-retryable split treats it exactly like the real thing."""
+
+    def __init__(self, message: str = "injected network flap"):
+        super().__init__(message)
+        self._message = message
+
+    def code(self) -> grpc.StatusCode:
+        return grpc.StatusCode.UNAVAILABLE
+
+    def details(self) -> str:
+        return self._message
+
+
+def _round_of(msg) -> int | None:
+    """The protocol round a ClientMessage speaks about, if any."""
+    kind = msg.WhichOneof("msg")
+    if kind == "done":
+        return int(msg.done.round)
+    if kind == "training":
+        return int(msg.training.round)
+    if kind == "poll":
+        return int(msg.poll.round)
+    return None
+
+
+def _poison_weights(blob: bytes, mode: str) -> bytes:
+    if mode == TRUNCATE_PAYLOAD:
+        return blob[: max(1, len(blob) // 2)]
+    if mode == CORRUPT_PAYLOAD:
+        # Mangle the msgpack STRUCTURE (leading map/key bytes), not a float
+        # payload byte: structural damage is what checksums-free transports
+        # actually deliver detectably, and it deterministically fails the
+        # server's decode instead of landing plausible garbage values.
+        head = bytes(b ^ 0xFF for b in blob[:8])
+        return head + blob[8:]
+    if mode == NAN_UPDATE:
+        import numpy as np
+
+        from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
+        import jax
+
+        tree = tree_from_bytes(blob)
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        poisoned = []
+        for i, leaf in enumerate(leaves):
+            arr = np.asarray(leaf)
+            if i == 0 and arr.dtype.kind == "f":
+                arr = np.full_like(arr, np.nan)
+            poisoned.append(arr)
+        return tree_to_bytes(jax.tree_util.tree_unflatten(treedef, poisoned))
+    raise ValueError(f"not a payload poison: {mode}")
+
+
+class ClientChaos:
+    """Per-client fault hook; attach one instance per injected FedClient."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._flap_left = 0
+        self._crash_armed = False
+
+    # -- FedClient hook points --
+
+    def before_send(self, cname: str, msg) -> None:
+        """May raise (crash/flap), sleep (straggler), or mutate ``msg`` in
+        place (payload poisons). Runs INSIDE the retry loop: a raised flap
+        goes through the same except-path a real UNAVAILABLE would."""
+        if self._crash_armed:
+            raise InjectedCrash(f"{cname}: crash after upload")
+        rnd = _round_of(msg)
+        fault = self.plan.take(NETWORK_FLAP, client=cname, round=rnd)
+        if fault is not None:
+            self._flap_left = fault.count
+        if self._flap_left > 0:
+            self._flap_left -= 1
+            raise InjectedRpcError(f"{cname}: injected flap")
+        if msg.WhichOneof("msg") != "done":
+            return
+        if self.plan.take(CRASH_BEFORE_UPLOAD, client=cname, round=rnd) is not None:
+            raise InjectedCrash(f"{cname}: crash before upload (round {rnd})")
+        fault = self.plan.take(STRAGGLER_DELAY, client=cname, round=rnd)
+        if fault is not None:
+            time.sleep(fault.delay_s)
+        for mode in (CORRUPT_PAYLOAD, TRUNCATE_PAYLOAD, NAN_UPDATE):
+            if self.plan.take(mode, client=cname, round=rnd) is not None:
+                msg.done.weights = _poison_weights(msg.done.weights, mode)
+        if self.plan.take(STALE_REPLAY, client=cname, round=rnd) is not None:
+            msg.done.round = max(1, int(msg.done.round) - 1)
+
+    def after_reply(self, cname: str, msg, reply) -> None:
+        """Crash AFTER the server processed the upload: ``during`` dies here
+        (the client never learns its report landed), ``after`` arms a crash
+        for the next call (the client knew, then died)."""
+        if msg.WhichOneof("msg") != "done":
+            return
+        rnd = _round_of(msg)
+        if self.plan.take(CRASH_DURING_UPLOAD, client=cname, round=rnd) is not None:
+            raise InjectedCrash(f"{cname}: crash during upload (round {rnd})")
+        if self.plan.take(CRASH_AFTER_UPLOAD, client=cname, round=rnd) is not None:
+            self._crash_armed = True
+
+
+class MeshChaos:
+    """``fault_injector`` for the mesh driver's bounded-retry round loop."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+
+    def __call__(self, round_idx: int, attempt: int):
+        """Called before each attempt of round ``round_idx``. Raises for a
+        device failure; returns a ``(variables, metrics) -> (variables,
+        metrics)`` poison for silent corruption; None for a clean attempt.
+        One-shot semantics mean the post-failure replay runs clean."""
+        if self.plan.take(MESH_DEVICE_FAIL, round=round_idx) is not None:
+            raise InjectedDeviceFailure(
+                f"injected device failure (round {round_idx}, attempt {attempt})"
+            )
+        if self.plan.take(MESH_NONFINITE, round=round_idx) is not None:
+            return _nan_poison
+        return None
+
+
+def _nan_poison(variables, metrics):
+    import jax
+    import jax.numpy as jnp
+
+    def nanify(tree):
+        return jax.tree_util.tree_map(
+            lambda a: (
+                jnp.full_like(a, jnp.nan)
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+                else a
+            ),
+            tree,
+        )
+
+    return nanify(variables), nanify(metrics)

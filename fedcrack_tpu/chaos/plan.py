@@ -1,0 +1,150 @@
+"""Deterministic fault plans: WHAT breaks, WHERE, and WHEN.
+
+A :class:`FaultPlan` is an explicit, seed-reproducible schedule of injected
+failures. It is pure bookkeeping — the plan never touches the transport or
+the mesh itself; the hooks in :mod:`fedcrack_tpu.chaos.inject` consult it at
+well-defined points and act on what it returns. Faults are ONE-SHOT: the
+first hook that matches a fault consumes it (:meth:`FaultPlan.take`), so a
+retried call or a replayed round does not re-trip the same failure — which
+is exactly what makes bounded-retry recovery testable.
+
+Determinism contract: a plan built from ``FaultPlan.generate(seed, ...)``
+with the same arguments always produces the same fault schedule, and a
+scenario driven by the same plan + the same cohort is replayable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Iterable
+
+# ---- fault kinds ----
+# Transport plane (client-side hooks; fedcrack_tpu.transport.client).
+CRASH_BEFORE_UPLOAD = "crash_before_upload"    # dies before TrainDone is sent
+CRASH_DURING_UPLOAD = "crash_during_upload"    # dies after send, before the reply
+CRASH_AFTER_UPLOAD = "crash_after_upload"      # dies on the first call after reporting
+STRAGGLER_DELAY = "straggler_delay"            # sleeps delay_s before reporting
+NETWORK_FLAP = "network_flap"                  # next `count` RPCs fail UNAVAILABLE
+CORRUPT_PAYLOAD = "corrupt_payload"            # TrainDone weights bytes mangled
+TRUNCATE_PAYLOAD = "truncate_payload"          # TrainDone weights cut in half
+NAN_UPDATE = "nan_update"                      # TrainDone weights re-encoded with NaNs
+STALE_REPLAY = "stale_replay"                  # TrainDone re-tagged with round-1
+
+# Mesh plane (driver hook; fedcrack_tpu.parallel.driver fault_injector).
+MESH_DEVICE_FAIL = "mesh_device_fail"          # round dispatch raises (preemption)
+MESH_NONFINITE = "mesh_nonfinite"              # round output poisoned with NaNs
+
+CLIENT_KINDS = frozenset(
+    {
+        CRASH_BEFORE_UPLOAD,
+        CRASH_DURING_UPLOAD,
+        CRASH_AFTER_UPLOAD,
+        STRAGGLER_DELAY,
+        NETWORK_FLAP,
+        CORRUPT_PAYLOAD,
+        TRUNCATE_PAYLOAD,
+        NAN_UPDATE,
+        STALE_REPLAY,
+    }
+)
+MESH_KINDS = frozenset({MESH_DEVICE_FAIL, MESH_NONFINITE})
+ALL_KINDS = CLIENT_KINDS | MESH_KINDS
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One scheduled failure.
+
+    ``round`` is the protocol round (1-based) for client faults and the
+    driver round index (0-based) for mesh faults — each plane's natural
+    numbering. ``client`` is the target cname (None for mesh faults).
+    """
+
+    kind: str
+    round: int
+    client: str | None = None
+    delay_s: float = 0.0     # STRAGGLER_DELAY: how long to stall
+    count: int = 1           # NETWORK_FLAP: how many consecutive RPCs fail
+
+    def __post_init__(self) -> None:
+        if self.kind not in ALL_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.count < 1:
+            raise ValueError(f"count must be >= 1, got {self.count}")
+
+
+class FaultPlan:
+    """A consumable schedule of :class:`Fault` s.
+
+    Mutability is deliberate and single-threaded-per-target: each injected
+    client owns its own hook object, and hooks consume faults under the
+    caller's thread. The plan records everything it fired in ``triggered``
+    (order of consumption), so scenario tests can assert that the schedule
+    actually ran instead of silently matching nothing.
+    """
+
+    def __init__(self, faults: Iterable[Fault] = ()):
+        self.pending: list[Fault] = list(faults)
+        self.triggered: list[Fault] = []
+
+    def __len__(self) -> int:
+        return len(self.pending)
+
+    def take(
+        self,
+        kind: str,
+        *,
+        client: str | None = None,
+        round: int | None = None,
+    ) -> Fault | None:
+        """Consume and return the first pending fault matching ``kind``,
+        ``client`` and ``round``; None when nothing matches. A fault with
+        ``client=None`` matches any client; every fault pins a round, so a
+        hook point that cannot see one (``round=None`` — e.g. an enroll
+        message) never matches."""
+        for i, f in enumerate(self.pending):
+            if f.kind != kind:
+                continue
+            if f.client is not None and f.client != client:
+                continue
+            if round is None or f.round != round:
+                continue
+            del self.pending[i]
+            self.triggered.append(f)
+            return f
+        return None
+
+    @classmethod
+    def generate(
+        cls,
+        seed: int,
+        *,
+        n_rounds: int,
+        clients: Iterable[str],
+        kinds: Iterable[str] | None = None,
+        n_faults: int = 3,
+        max_delay_s: float = 0.5,
+    ) -> "FaultPlan":
+        """A seeded random schedule over the given rounds x clients — the
+        long-horizon soak's input. Client kinds draw a (client, round) pair;
+        mesh kinds draw a 0-based round. Same seed, same schedule."""
+        rng = random.Random(seed)
+        kind_pool = sorted(kinds if kinds is not None else CLIENT_KINDS)
+        names = sorted(clients)
+        faults = []
+        for _ in range(n_faults):
+            kind = rng.choice(kind_pool)
+            if kind in MESH_KINDS:
+                faults.append(Fault(kind=kind, round=rng.randrange(n_rounds)))
+            else:
+                faults.append(
+                    Fault(
+                        kind=kind,
+                        round=rng.randint(1, n_rounds),
+                        client=rng.choice(names) if names else None,
+                        delay_s=round(rng.uniform(0.05, max_delay_s), 3),
+                        count=rng.randint(1, 2),
+                    )
+                )
+        return cls(faults)

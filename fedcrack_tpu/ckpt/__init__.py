@@ -10,7 +10,11 @@ reads; SURVEY.md §5.4). Here both planes checkpoint durably:
   left off (a fresh enrollment window opens, then rounds continue from the
   restored round counter);
 - the centralized trainer keeps best-val and latest states (the reference's
-  ``ModelCheckpoint(save_best_only=True)``, test/Segmentation.py:177-179).
+  ``ModelCheckpoint(save_best_only=True)``, test/Segmentation.py:177-179);
+- the mid-round statefile (``statefile.py``, ``FedConfig.state_path``)
+  covers what orbax's round-boundary steps cannot: cohort/phase and the
+  already-received update blobs, atomically snapshotted on every change so
+  a server killed MID-round resumes the same round (round 8).
 
 Orbax is the TPU-native choice: zarr-sharded array storage, async-safe,
 restores straight onto whatever device/sharding layout the restore-side
@@ -23,10 +27,20 @@ from fedcrack_tpu.ckpt.manager import (
     restore_server_state,
     save_server_state,
 )
+from fedcrack_tpu.ckpt.statefile import (
+    load_state_file,
+    save_state_file,
+    server_state_from_bytes,
+    server_state_to_bytes,
+)
 
 __all__ = [
     "FedCheckpoint",
     "FedCheckpointer",
+    "load_state_file",
     "restore_server_state",
     "save_server_state",
+    "save_state_file",
+    "server_state_from_bytes",
+    "server_state_to_bytes",
 ]

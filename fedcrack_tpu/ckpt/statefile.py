@@ -1,0 +1,153 @@
+"""Mid-round durable server state: the crash-consistency layer the orbax
+checkpoint cannot provide.
+
+``ckpt.manager`` saves at ROUND BOUNDARIES (one orbax step per aggregation).
+Everything between boundaries — the enrolled cohort, the phase, and above
+all the ``received`` update blobs — used to die with the process: a server
+killed after K of N clients reported restarted the round from zero and
+silently threw away K finished local fits. This module persists the full
+:class:`fedcrack_tpu.fed.rounds.ServerState` as one msgpack blob through
+``ioutils.atomic_write_bytes`` (write-temp + fsync + atomic rename), so the
+file on disk is always a complete, parseable snapshot — a kill between
+write and rename leaves the previous snapshot plus an ignorable ``*.tmp.*``
+sibling (pinned by the chaos suite).
+
+What is NOT persisted: monotonic timestamps (``round_started_at`` /
+``enroll_opened_at`` are process-local clocks; the restored state re-arms
+them from the first event the new process sees) and the config (the booting
+server's config wins — derived fields like the decode template and the
+wire-dtype broadcast copy are rebuilt through ``initial_state`` exactly as
+on a fresh boot).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any
+
+import msgpack
+
+from fedcrack_tpu.ioutils import atomic_write_bytes
+
+log = logging.getLogger("fedcrack.ckpt.statefile")
+
+STATE_FORMAT = 1
+
+
+def server_state_to_bytes(state: Any) -> bytes:
+    """Serialize the dynamic fields of a ``ServerState`` (msgpack, no
+    pickle — same trust posture as the wire)."""
+    from flax import serialization as flax_ser
+
+    from fedcrack_tpu.fed.serialization import tree_to_bytes
+
+    opt_blob = None
+    if state.server_opt_state is not None:
+        # Round-trip optimizer moments through flax's state-dict view: optax
+        # states are namedtuples of arrays, which msgpack cannot carry
+        # directly but whose state-dict (nested plain dicts) it can.
+        opt_blob = tree_to_bytes(flax_ser.to_state_dict(state.server_opt_state))
+    payload = {
+        "format": STATE_FORMAT,
+        "phase": state.phase,
+        "cohort": sorted(state.cohort),
+        "departed": sorted(state.departed),
+        "current_round": int(state.current_round),
+        "model_version": int(state.model_version),
+        "failed_rounds": int(state.failed_rounds),
+        "global_blob": state.global_blob,
+        "received": {
+            name: [blob, int(ns)] for name, (blob, ns) in state.received.items()
+        },
+        "logs": dict(state.logs),
+        "history": [dict(h) for h in state.history],
+        "rejected": dict(state.rejected),
+        "opt_state": opt_blob,
+    }
+    return msgpack.packb(payload, use_bin_type=True)
+
+
+def server_state_from_bytes(blob: bytes, config: Any) -> Any:
+    """Rebuild a live ``ServerState`` under ``config``. Derived fields
+    (float32 decode template, wire-dtype broadcast blob) are reconstructed
+    via ``initial_state`` so a wire-dtype change between runs cannot leave
+    a stale broadcast copy."""
+    from fedcrack_tpu.fed import rounds as R
+    from fedcrack_tpu.fed.serialization import tree_from_bytes
+
+    payload = msgpack.unpackb(blob, raw=False)
+    if payload.get("format") != STATE_FORMAT:
+        raise ValueError(f"unknown statefile format {payload.get('format')!r}")
+    variables = tree_from_bytes(payload["global_blob"])
+    state = R.initial_state(config, variables)
+    opt_state = None
+    if payload.get("opt_state") is not None:
+        from flax import serialization as flax_ser
+
+        from fedcrack_tpu.fed.algorithms import make_server_optimizer
+
+        tx = make_server_optimizer(
+            config.server_optimizer, config.server_lr, config.server_momentum
+        )
+        if tx is not None and "params" in variables:
+            try:
+                opt_state = flax_ser.from_state_dict(
+                    tx.init(variables["params"]),
+                    tree_from_bytes(payload["opt_state"]),
+                )
+            except (ValueError, KeyError, TypeError):
+                log.warning(
+                    "statefile optimizer moments do not match the configured "
+                    "server optimizer %r; restarting moments from zero",
+                    config.server_optimizer,
+                )
+    phase = payload["phase"]
+    if payload["current_round"] > config.max_rounds:
+        phase = R.PHASE_FINISHED
+    return state._replace(
+        phase=phase,
+        cohort=frozenset(payload["cohort"]),
+        departed=frozenset(payload["departed"]),
+        current_round=payload["current_round"],
+        model_version=payload["model_version"],
+        failed_rounds=payload["failed_rounds"],
+        received={
+            name: (bytes(pair[0]), int(pair[1]))
+            for name, pair in payload["received"].items()
+        },
+        logs={k: bytes(v) for k, v in payload["logs"].items()},
+        history=tuple(payload["history"]),
+        rejected=dict(payload.get("rejected", {})),
+        server_opt_state=opt_state,
+        # Monotonic clocks do not survive a process: re-arm on first event
+        # (rounds._advance_time stamps round_started_at when RUNNING).
+        enroll_opened_at=None,
+        round_started_at=None,
+    )
+
+
+def save_state_file(path: str, state: Any) -> None:
+    """One atomic, fsync'd snapshot; the previous snapshot survives any
+    crash up to the rename instant."""
+    atomic_write_bytes(path, server_state_to_bytes(state))
+
+
+def load_state_file(path: str, config: Any) -> Any | None:
+    """The latest durable snapshot, or None (missing file, or an unreadable
+    one — which the atomic writer makes possible only via external
+    corruption; it is logged, never fatal, and the orbax round-boundary
+    checkpoint remains the fallback)."""
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except FileNotFoundError:
+        return None
+    except OSError:
+        log.exception("statefile %s unreadable", path)
+        return None
+    try:
+        return server_state_from_bytes(blob, config)
+    except Exception:
+        log.exception("statefile %s corrupt; falling back to the checkpoint", path)
+        return None

@@ -117,6 +117,27 @@ class FedConfig:
     # Per-round deadline; on expiry the cohort shrinks to the clients that
     # reported (fixes the reference's forever-hanging barrier, SURVEY.md §5.3).
     round_deadline_s: float = 0.0  # 0 = no deadline
+    # Quorum aggregation (Bonawitz et al., MLSys 2019: over-provision the
+    # cohort, aggregate at a goal count instead of the full barrier): the
+    # round closes as soon as ceil(quorum_fraction * |cohort|) updates are
+    # in. 1.0 keeps the full barrier (reference semantics); the deadline
+    # stays as the backstop either way. Stragglers whose report lands after
+    # the quorum closed the round are re-synced to the current round (their
+    # late update is logged to history, never averaged).
+    quorum_fraction: float = 1.0
+    # Update sanitation before FedAvg: every TrainDone payload is checked
+    # against the global template (decodable, leaf count, per-leaf shape,
+    # finite values) and rejected — logged to the round's history entry —
+    # instead of averaged. A single NaN client otherwise poisons the global
+    # model for every client. Disable only for wire-format experiments.
+    sanitize_updates: bool = True
+    # Mid-round durable server state (msgpack via atomic write+fsync+rename;
+    # empty disables): persists cohort/phase/received blobs on every
+    # membership or upload change, so a server killed MID-round resumes the
+    # same round with the already-received updates intact (the orbax
+    # checkpoint only covers round boundaries). Restored in preference to
+    # the orbax checkpoint when strictly newer.
+    state_path: str = ""
     # FedProx proximal term; 0 disables (plain FedAvg).
     fedprox_mu: float = 0.0
     # Crack-pixel loss weight (1 + (pos_weight-1)*mask scales each pixel's
@@ -233,6 +254,10 @@ class FedConfig:
             raise ValueError(
                 f"segments={self.segments} must divide "
                 f"local_epochs={self.local_epochs} (epoch-grain segmentation)"
+            )
+        if not 0.0 < self.quorum_fraction <= 1.0:
+            raise ValueError(
+                f"quorum_fraction must be in (0, 1], got {self.quorum_fraction}"
             )
         if self.wire_dtype not in ("float32", "bfloat16"):
             raise ValueError(

@@ -2,6 +2,7 @@ from fedcrack_tpu.fed.algorithms import fedavg, fedprox_penalty  # noqa: F401
 from fedcrack_tpu.fed.serialization import (  # noqa: F401
     tree_from_bytes,
     tree_to_bytes,
+    validate_update,
 )
 from fedcrack_tpu.fed.rounds import (  # noqa: F401
     ServerState,

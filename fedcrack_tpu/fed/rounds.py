@@ -33,11 +33,28 @@ Deliberate fixes over observed reference behavior (SURVEY.md §2.2):
 7. The in-memory log sink is capped per upload and in total; over-cap
    chunks get an explicit ``REJECTED`` (the reference streamed unbounded
    bytes into server memory before its disk write, fl_server.py:84-89).
+8. Quorum aggregation (``FedConfig.quorum_fraction``, Bonawitz et al.
+   MLSys 2019): the round closes at K-of-N received updates instead of the
+   full barrier; the deadline stays as the backstop. A straggler whose
+   report lands after the round closed is RE-SYNCED to the current round
+   (``NOT_WAIT`` + weights) instead of being rejected to death — its late
+   update is logged to history, never averaged (FedProx's lesson: partial
+   client work is tolerated by the aggregator, not papered over).
+9. Update sanitation before FedAvg (``FedConfig.sanitize_updates``): every
+   ``TrainDone`` payload must decode, match the global template leaf-for-
+   leaf in shape, and be fully finite — otherwise it is ``REJECTED`` and
+   recorded in the round's ``rejected`` history map. The reference averaged
+   whatever unpickled.
+10. Mid-round durable state (``FedConfig.state_path`` + ckpt/statefile.py):
+    cohort/phase/received survive a server kill, so a restart resumes the
+    SAME round; restored monotonic timestamps are discarded and the
+    deadline re-arms from the first post-restart event.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import math
 from dataclasses import dataclass
 from typing import Any, Mapping
 
@@ -49,7 +66,11 @@ from fedcrack_tpu.fed.algorithms import (
     fedavg,
     make_server_optimizer,
 )
-from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
+from fedcrack_tpu.fed.serialization import (
+    tree_from_bytes,
+    tree_to_bytes,
+    validate_update,
+)
 
 # ---- status codes (reference vocabulary, §2.4) ----
 SW = "SW"                # enrolled in this session's cohort
@@ -174,6 +195,11 @@ class ServerState:
     # member that restarts may re-admit itself via Ready (fix #6 must hold
     # even when the crash outlives the deadline).
     departed: frozenset[str] = frozenset()
+    # Updates refused for THIS round (cname -> reason): sanitation failures
+    # (undecodable / wrong shape / non-finite) and post-quorum stragglers.
+    # Folded into the round's history entry at aggregation — rejected
+    # updates are observable forever but averaged never.
+    rejected: Mapping[str, str] = dataclasses.field(default_factory=dict)
 
     @property
     def broadcast_blob(self) -> bytes:
@@ -232,16 +258,40 @@ def _ready_config(state: ServerState, status: str) -> dict[str, Any]:
     }
 
 
+def _quorum_target(state: ServerState) -> int:
+    """K of the K-of-N barrier: ceil(quorum_fraction * |cohort|), floored at
+    one real update. 1.0 (the default) is the full barrier. The epsilon
+    guards float products like 0.6 * 5 = 3.0000000000000004 from ceiling
+    into an extra required client."""
+    return max(
+        1, math.ceil(state.config.quorum_fraction * len(state.cohort) - 1e-9)
+    )
+
+
 def _barrier_met(state: ServerState) -> bool:
     return (
         state.phase == PHASE_RUNNING
         and bool(state.cohort)
-        and len(state.received) >= len(state.cohort)
+        and len(state.received) >= _quorum_target(state)
     )
 
 
 def _advance_time(state: ServerState, now: float) -> ServerState:
     """Apply pure time effects: enrollment close, round deadline."""
+    # A statefile-restored state carries no timestamps (the dead process's
+    # monotonic clocks are meaningless here): both time windows re-arm from
+    # the first event the restarted server sees. Without the enrollment
+    # re-arm, a server killed mid-enrollment restores a partial cohort whose
+    # window can never expire — already-enrolled clients don't re-send Ready,
+    # so the federation would sit in PHASE_ENROLL forever.
+    if state.phase == PHASE_RUNNING and state.round_started_at is None:
+        state = state._replace(round_started_at=now)
+    if (
+        state.phase == PHASE_ENROLL
+        and state.cohort
+        and state.enroll_opened_at is None
+    ):
+        state = state._replace(enroll_opened_at=now)
     if (
         state.phase == PHASE_ENROLL
         and state.enroll_opened_at is not None
@@ -256,8 +306,11 @@ def _advance_time(state: ServerState, now: float) -> ServerState:
         state.phase == PHASE_RUNNING
         and state.config.round_deadline_s > 0
         and state.round_started_at is not None
-        and now - state.round_started_at > state.config.round_deadline_s
-        and len(state.received) < len(state.cohort)
+        # ">=" like the enrollment window above: both time windows close AT
+        # the boundary instant (previously the deadline fired only strictly
+        # past it — an asymmetry this module's boundary-time test now pins).
+        and now - state.round_started_at >= state.config.round_deadline_s
+        and len(state.received) < _quorum_target(state)
     ):
         if state.received:
             # Deadline: aggregate over who reported; the missing clients are
@@ -334,6 +387,11 @@ def _aggregate(state: ServerState, now: float) -> ServerState:
         ),
         "bytes_received": sum(len(state.received[n][0]) for n in names),
         "bytes_broadcast": len(new_wire_blob or new_blob),
+        # Quorum observability: how many updates closed the round out of how
+        # large a cohort, plus every update refused this round and why.
+        "quorum": _quorum_target(state),
+        "cohort_size": len(state.cohort),
+        "rejected": dict(state.rejected),
     }
     return state._replace(
         global_blob=new_blob,
@@ -341,6 +399,7 @@ def _aggregate(state: ServerState, now: float) -> ServerState:
         current_round=new_round,
         model_version=state.model_version + 1,
         received={},
+        rejected={},
         round_started_at=now,
         phase=PHASE_FINISHED if finished else PHASE_RUNNING,
         history=state.history + (entry,),
@@ -386,8 +445,13 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
                 # enrollment closed — late client turned away (fl_server.py:78-81)
                 return state, Reply(status=CTW, config=_ready_config(state, CTW))
             opened = state.enroll_opened_at if state.enroll_opened_at is not None else now
+            # Leaving `departed` too: cohort and departed stay disjoint (the
+            # property test drives re-enrollment after a silent-cohort
+            # reopen, where the member is coming back from the departed set).
             state = state._replace(
-                enroll_opened_at=opened, cohort=state.cohort | {cname}
+                enroll_opened_at=opened,
+                cohort=state.cohort | {cname},
+                departed=state.departed - {cname},
             )
             # target cohort reached: close enrollment early (the reference
             # only had the fixed 10 s window, fl_server.py:40-52)
@@ -463,9 +527,27 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
                 return state, Reply(
                     status=REJECTED, config={"reason": "not in cohort"}
                 )
+            if rnd < state.current_round:
+                # A report for an already-closed round: a straggler that
+                # missed the quorum/deadline, or a replayed capture. Either
+                # way the update must never be averaged (it was computed
+                # against superseded weights) — log it, then RE-SYNC the
+                # sender with the current round + weights (NOT_WAIT, the
+                # same reply a version poll would get) so a live straggler
+                # rejoins instead of dying on a rejection.
+                reason = f"stale round {rnd} (server at {state.current_round})"
+                rejected = dict(state.rejected)
+                rejected[cname] = reason
+                state = state._replace(rejected=rejected)
+                return state, Reply(
+                    status=NOT_WAIT,
+                    blob=state.broadcast_blob,
+                    config=_ready_config(state, NOT_WAIT),
+                )
             if rnd != state.current_round:
-                # stale/future round: explicit rejection (fix #3; the
-                # reference returned None and crashed on encode)
+                # FUTURE round: a protocol violation no resync can explain —
+                # explicit rejection (fix #3; the reference returned None
+                # and crashed on encode).
                 return state, Reply(
                     status=REJECTED,
                     config={
@@ -474,6 +556,34 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
                         "server_round": state.current_round,
                     },
                 )
+            if state.config.sanitize_updates:
+                # Deliberate cost note: this decodes the payload once at
+                # receive and _aggregate decodes it again at the barrier —
+                # both inside the single-writer transition, like every other
+                # state-machine step (the machine stays a pure function; the
+                # transport layer stays a dumb adapter). The control plane's
+                # weight blobs are small whenever the TPU data plane carries
+                # the real traffic; an operator who needs multi-GB uploads
+                # sanitized off-thread should gate at the transport instead.
+                problem = None
+                if ns < 0:
+                    problem = f"negative sample count {ns}"
+                elif state.template is not None:
+                    problem = validate_update(blob, state.template)
+                if problem is not None:
+                    # Refused BEFORE it can touch FedAvg; observable in the
+                    # round's history entry. The client fails loudly — a
+                    # poisoned trainer must not silently keep federating.
+                    rejected = dict(state.rejected)
+                    rejected[cname] = problem
+                    state = state._replace(rejected=rejected)
+                    return state, Reply(
+                        status=REJECTED,
+                        config={
+                            "reason": f"update rejected: {problem}",
+                            "client_round": rnd,
+                        },
+                    )
             # NB: updates arriving while enrollment is still open are buffered
             # but never trigger aggregation — the cohort isn't final yet.
             received = dict(state.received)

@@ -31,6 +31,44 @@ def tree_to_bytes(tree: Any, cast_dtype: str | None = None) -> bytes:
     return serialization.msgpack_serialize(host)
 
 
+def validate_update(blob: bytes, template: Any) -> str | None:
+    """Sanitation gate for an untrusted client update: the reason the blob
+    must NOT enter FedAvg, or None when it is clean.
+
+    Checks, in order of what corrupts an aggregation worst-first: the bytes
+    decode at all (truncated/mangled wire), the leaf count matches the
+    global template, every leaf's shape matches exactly (a same-size
+    transpose would silently reshape into garbage weights), and every
+    numeric leaf is fully finite (one NaN client otherwise propagates into
+    the global average and from there to every client). Wire-dtype casts
+    (bfloat16 uploads) pass untouched — shape, not dtype, is the contract.
+    """
+    try:
+        raw = serialization.msgpack_restore(blob)
+    except Exception as e:  # msgpack raises several exception families
+        return f"undecodable payload ({type(e).__name__})"
+    flat_raw = jax.tree_util.tree_leaves(raw)
+    flat_template = jax.tree_util.tree_leaves(template)
+    if len(flat_raw) != len(flat_template):
+        return (
+            f"leaf count mismatch: payload has {len(flat_raw)}, "
+            f"template expects {len(flat_template)}"
+        )
+    for i, (r, t) in enumerate(zip(flat_raw, flat_template)):
+        if np.shape(r) != np.shape(np.asarray(t)):
+            return (
+                f"leaf {i} shape mismatch: payload {np.shape(r)}, "
+                f"template {np.shape(np.asarray(t))}"
+            )
+        try:
+            arr = np.asarray(r).astype(np.float32)
+        except (TypeError, ValueError):
+            return f"leaf {i} is non-numeric"
+        if not np.isfinite(arr).all():
+            return f"leaf {i} has non-finite values"
+    return None
+
+
 def tree_from_bytes(blob: bytes, template: Any | None = None) -> Any:
     """Deserialize msgpack bytes back to a pytree.
 

@@ -1,0 +1,41 @@
+"""Durable small-file writes shared by the control plane.
+
+Every non-orbax persistence site (the best-model pair in
+``transport/service.py``, the mid-round server statefile in
+``ckpt/statefile.py``) funnels through :func:`atomic_write_bytes`:
+write-temp + flush + fsync + atomic rename, so a crash at ANY instruction
+boundary leaves either the old complete file or the new complete file —
+never a torn one. A crash between write and rename strands a ``*.tmp.*``
+sibling, which readers must ignore (pinned by the chaos suite's
+kill-between-write-and-rename test). Orbax checkpoints are not routed here:
+``CheckpointManager`` already commits steps via its own temp-dir + rename
+protocol.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` so that the file is never observable in a
+    torn state: temp file in the same directory (rename must not cross a
+    filesystem), fsync before rename (the rename must never land before the
+    bytes), then ``os.replace``. Directory fsync is best-effort — on hosts
+    where it works, the *rename itself* also survives a power cut."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    try:
+        dfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass  # platform without directory fsync; rename atomicity still holds

@@ -38,6 +38,14 @@ the global variables at every round boundary; a restarted session restores
 the checkpoint, passes the restored variables plus ``start_round`` and
 continues the same trajectory (bit-identical on the deterministic path —
 the data_fn is called with absolute round indices either way).
+
+Preemption tolerance (round 8): ``max_round_retries > 0`` arms a bounded
+per-round retry loop — an attempt that raises (device/host loss) or emits
+non-finite weights/metrics is rolled back to the round boundary (durable
+checkpoint when available, else an in-memory snapshot) and replayed,
+bit-identically. The chaos suite drives it through
+``fault_injector`` (``chaos.inject.MeshChaos``); both knobs are zero-cost
+when off.
 """
 
 from __future__ import annotations
@@ -105,6 +113,29 @@ class RoundRecord:
     # Peak bytes of driver-staged round data live on the mesh at any point
     # during this round (current slab + however much of the next had landed).
     max_live_staged_bytes: int = 0
+    # Preemption-tolerance path only (max_round_retries > 0): how many
+    # failed attempts this round absorbed before the recorded (successful)
+    # one, and what each failure was ("InjectedDeviceFailure: ...",
+    # "non-finite round output", ...). 0/() on the default path.
+    retries: int = 0
+    faults: tuple = ()
+
+
+class NonFiniteRound(RuntimeError):
+    """A round produced NaN/Inf weights or metrics (detected only when
+    ``max_round_retries > 0`` — the detection costs one device reduction +
+    scalar readback per round, so the default path never pays it)."""
+
+
+def _tree_finite(tree: Any) -> bool:
+    """One fused device-side finiteness reduction over every float leaf,
+    a single scalar readback on the host."""
+    ok = jnp.asarray(True)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        a = jnp.asarray(leaf)
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            ok = jnp.logical_and(ok, jnp.isfinite(a).all())
+    return bool(ok)
 
 
 def _barrier_read(x: jax.Array) -> None:
@@ -287,6 +318,8 @@ def run_mesh_federation(
     checkpointer: Any | None = None,
     start_round: int = 0,
     history: Sequence[dict] = (),
+    max_round_retries: int = 0,
+    fault_injector: Callable[[int, int], Any] | None = None,
 ) -> tuple[Any, list[RoundRecord]]:
     """Drive federated rounds ``start_round .. n_rounds-1`` through
     ``round_fn``.
@@ -331,6 +364,26 @@ def run_mesh_federation(
     - ``start_round``: absolute index of the first round to run (checkpoint
       resume); ``data_fn`` and ``RoundRecord.round_idx`` use absolute
       indices throughout.
+    - ``max_round_retries``: preemption tolerance (0 disables, the default
+      — no snapshotting, no finiteness checks, no overhead). With N > 0,
+      each round absorbs up to N failed attempts: an attempt that raises
+      (device/host loss) or produces non-finite weights/metrics is rolled
+      back — weights restored from this round's boundary (the
+      ``checkpointer``'s latest step when present, else an in-memory host
+      snapshot taken at round start) — and replayed with the same
+      ``data_fn(r)`` data, so the recovered trajectory is bit-identical to
+      an unfaulted run (test-pinned). Attempt N+1's failure re-raises: a
+      clean abort, never a hang. Per-round cost when enabled: one host
+      ``device_get`` of the weights + one fused device-side finiteness
+      reduction. NOTE: bit-identical replay requires ``data_fn`` to be a
+      pure function of the round index — a data_fn advancing a shared RNG
+      per CALL (rather than seeding from ``r``) yields a different shuffle
+      on the replayed attempt (still a valid federation, not the pinned
+      identical trajectory).
+    - ``fault_injector``: chaos hook (``chaos.inject.MeshChaos``), called
+      as ``injector(round_idx, attempt)`` before each attempt; it may raise
+      (simulated preemption) or return an output-poisoning transform.
+      Production runs leave it None.
 
     Returns the final global ``variables`` (on device) and one
     :class:`RoundRecord` per executed round. The first round's wall-clock
@@ -348,6 +401,10 @@ def run_mesh_federation(
     if not 0 <= start_round < n_rounds:
         raise ValueError(
             f"start_round={start_round} outside [0, n_rounds={n_rounds})"
+        )
+    if max_round_retries < 0:
+        raise ValueError(
+            f"max_round_retries must be >= 0, got {max_round_retries}"
         )
     spec = image_spec if image_spec is not None else P(CLIENTS, None, BATCH)
     seg = round_fn if isinstance(round_fn, SegmentedRound) else None
@@ -380,61 +437,120 @@ def run_mesh_federation(
 
     records: list[RoundRecord] = []
     for r in range(start_round, n_rounds):
-        acct["round_max"] = acct["live"]
-        next_buffers = None
-        next_cohort = None
-        next_bytes = 0
-        next_data_s = 0.0
-        next_staging_s = 0.0
-        timeline: list[dict] = []
+        # Preemption tolerance: snapshot the round's input weights so a
+        # failed attempt (device loss, non-finite output) can replay THIS
+        # round from identical state. Host device_get round-trips float32
+        # exactly, so the replayed trajectory is bit-identical (test-pinned).
+        snapshot = jax.device_get(variables) if max_round_retries > 0 else None
+        attempt = 0
+        round_faults: list[str] = []
+        while True:
+            acct["round_max"] = acct["live"]
+            next_buffers = None
+            next_cohort = None
+            next_bytes = 0
+            next_data_s = 0.0
+            next_staging_s = 0.0
+            timeline: list[dict] = []
 
-        t0 = time.perf_counter()
-        if seg is None:
-            variables, metrics = round_fn(variables, si, sm, active, n_samples)
+            t0 = time.perf_counter()
+            try:
+                post = None
+                if fault_injector is not None:
+                    # Chaos hook (chaos.inject.MeshChaos): may raise (device
+                    # failure) or return an output poison; one attribute
+                    # check when absent.
+                    post = fault_injector(r, attempt)
+                if seg is None:
+                    out_vars, metrics = round_fn(
+                        variables, si, sm, active, n_samples
+                    )
+                    if post is not None:
+                        out_vars, metrics = post(out_vars, metrics)
 
-            if overlap_staging and r + 1 < n_rounds:
-                # The round program is in flight; data_fn's host work and the
-                # staging transfers ride under it (the barrier inside
-                # stage_round_data only waits for the *transfer*, not the
-                # round), which is why this round's wall embeds them — see
-                # RoundRecord.
-                td = time.perf_counter()
-                nxt = data_fn(r + 1)
-                next_data_s = time.perf_counter() - td
-                if nxt is not None:
-                    ni, nm, na, nn = nxt
-                    next_cohort = (na, nn)
-                    next_bytes = int(ni.nbytes + nm.nbytes)
-                    next_buffers = stage_round_data(ni, nm, mesh, spec)
-                    acct["live"] += next_bytes
-                    acct["round_max"] = max(acct["round_max"], acct["live"])
-        else:
-            variables, metrics, segout = _run_segmented_round(
-                seg,
-                variables,
-                si,
-                sm,
-                active,
-                n_samples,
-                data_fn=data_fn,
-                round_idx=r,
-                n_rounds=n_rounds,
-                overlap_staging=overlap_staging,
-                n_chunks=n_chunks,
-                mesh=mesh,
-                spec=spec,
-                acct=acct,
-            )
-            timeline = segout["timeline"]
-            next_buffers = segout["next_buffers"]
-            next_cohort = segout["next_cohort"]
-            next_bytes = segout["next_bytes"]
-            next_data_s = segout["next_data_s"]
-            active, n_samples = segout["active"], segout["n_samples"]
+                    if overlap_staging and r + 1 < n_rounds:
+                        # The round program is in flight; data_fn's host work
+                        # and the staging transfers ride under it (the
+                        # barrier inside stage_round_data only waits for the
+                        # *transfer*, not the round), which is why this
+                        # round's wall embeds them — see RoundRecord.
+                        td = time.perf_counter()
+                        nxt = data_fn(r + 1)
+                        next_data_s = time.perf_counter() - td
+                        if nxt is not None:
+                            ni, nm, na, nn = nxt
+                            next_cohort = (na, nn)
+                            next_bytes = int(ni.nbytes + nm.nbytes)
+                            next_buffers = stage_round_data(ni, nm, mesh, spec)
+                            acct["live"] += next_bytes
+                            acct["round_max"] = max(
+                                acct["round_max"], acct["live"]
+                            )
+                else:
+                    out_vars, metrics, segout = _run_segmented_round(
+                        seg,
+                        variables,
+                        si,
+                        sm,
+                        active,
+                        n_samples,
+                        data_fn=data_fn,
+                        round_idx=r,
+                        n_rounds=n_rounds,
+                        overlap_staging=overlap_staging,
+                        n_chunks=n_chunks,
+                        mesh=mesh,
+                        spec=spec,
+                        acct=acct,
+                    )
+                    if post is not None:
+                        out_vars, metrics = post(out_vars, metrics)
+                    timeline = segout["timeline"]
+                    next_buffers = segout["next_buffers"]
+                    next_cohort = segout["next_cohort"]
+                    next_bytes = segout["next_bytes"]
+                    next_data_s = segout["next_data_s"]
+                    active, n_samples = segout["active"], segout["n_samples"]
 
-        # Round barrier: the metrics depend on every step of every client.
-        metrics_host = jax.tree_util.tree_map(np.asarray, metrics)
-        wall = time.perf_counter() - t0
+                if max_round_retries > 0 and not (
+                    _tree_finite(metrics) and _tree_finite(out_vars)
+                ):
+                    raise NonFiniteRound(
+                        f"round {r} produced non-finite weights/metrics"
+                    )
+                # Round barrier: metrics depend on every step of every client.
+                metrics_host = jax.tree_util.tree_map(np.asarray, metrics)
+                variables = out_vars
+                wall = time.perf_counter() - t0
+                break
+            except Exception as e:
+                if attempt >= max_round_retries:
+                    raise
+                round_faults.append(f"{type(e).__name__}: {e}")
+                attempt += 1
+                # Drop whatever of the NEXT round landed during the failed
+                # attempt; the retry re-produces it (deterministic data_fn).
+                if next_buffers is not None:
+                    flat = (
+                        tuple(next_buffers[0]) + tuple(next_buffers[1])
+                        if seg is not None
+                        else next_buffers
+                    )
+                    _delete_staged(flat)
+                acct["live"] = cur_bytes
+                # Restore the round's input weights: prefer the durable
+                # checkpoint (it IS this round's boundary when present —
+                # a real preemption may have taken the in-memory snapshot
+                # down with the host), else the host snapshot.
+                restored = None
+                if checkpointer is not None:
+                    try:
+                        ck = checkpointer.restore(template=snapshot)
+                        if ck is not None and ck.current_round == r:
+                            restored = ck.variables
+                    except Exception:
+                        restored = None
+                variables = restored if restored is not None else snapshot
 
         if not overlap_staging and r + 1 < n_rounds:
             # Sequential mode: produce AND stage the next round's data after
@@ -476,6 +592,8 @@ def run_mesh_federation(
             overlapped=overlap_staging and next_buffers is not None,
             segments=tuple(timeline),
             max_live_staged_bytes=acct["round_max"],
+            retries=attempt,
+            faults=tuple(round_faults),
         )
         records.append(record)
         if on_round is not None:

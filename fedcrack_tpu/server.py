@@ -31,6 +31,21 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
     p.add_argument("--host")
     p.add_argument("--registration-window", type=float, dest="registration_window_s")
     p.add_argument("--round-deadline", type=float, dest="round_deadline_s")
+    p.add_argument(
+        "--quorum-fraction",
+        type=float,
+        dest="quorum_fraction",
+        help="aggregate at ceil(f * cohort) received updates instead of the "
+        "full barrier (Bonawitz et al.); stragglers are re-synced, the "
+        "round deadline stays as backstop; 1.0 = full barrier",
+    )
+    p.add_argument(
+        "--state-path",
+        dest="state_path",
+        help="mid-round durable server state (atomic msgpack snapshot of "
+        "cohort/phase/received): a server killed mid-round resumes the "
+        "SAME round with the already-received updates intact",
+    )
     p.add_argument("--fedprox-mu", type=float, dest="fedprox_mu")
     p.add_argument(
         "--pos-weight",
@@ -145,6 +160,8 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         ("host", "host"),
         ("registration_window_s", "registration_window_s"),
         ("round_deadline_s", "round_deadline_s"),
+        ("quorum_fraction", "quorum_fraction"),
+        ("state_path", "state_path"),
         ("fedprox_mu", "fedprox_mu"),
         ("pos_weight", "pos_weight"),
         ("server_optimizer", "server_optimizer"),

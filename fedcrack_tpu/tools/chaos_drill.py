@@ -1,0 +1,196 @@
+"""Kill→restart recovery drill: time the mid-round server crash path.
+
+``python -m fedcrack_tpu.tools.chaos_drill --out drill.json``
+
+The scripted scenario (deterministic, raw-RPC driven, tiny weights — no
+JAX model, runs in seconds on any host):
+
+1. boot a coordinator with a durable statefile (``FedConfig.state_path``),
+2. enroll a 2-client cohort, deliver client A's round-1 update,
+3. KILL the server with zero grace mid-round (client B still training),
+4. boot a fresh coordinator over the same statefile,
+5. deliver client B's update — the round must aggregate using A's update
+   restored from disk, with the exact weighted average and an unbroken
+   history prefix — then drive the remaining rounds to FIN.
+
+Timings reported: ``restore_s`` (dead process → resumed state machine),
+``kill_to_recover_s`` (kill instant → the interrupted round's aggregation),
+and ``session_s``. bench.py embeds this via :func:`run_kill_restart_drill`
+as ``detail.chaos_recovery``; tests/test_chaos.py pins the semantics
+(identical history prefix, exact average) so the timing artifact can never
+go green on wrong recovery.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from fedcrack_tpu.configs import FedConfig
+from fedcrack_tpu.fed import rounds as R
+from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
+
+
+def _vars(value: float):
+    return {"params": {"w": np.full((4, 4), value, np.float32)}}
+
+
+def _raw_caller(port: int):
+    """One-message-per-call raw client on the shared bidi method."""
+    import grpc
+
+    from fedcrack_tpu.transport import transport_pb2 as pb
+    from fedcrack_tpu.transport.service import METHOD, SERVICE_NAME
+
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    method = channel.stream_stream(
+        f"/{SERVICE_NAME}/{METHOD}",
+        request_serializer=pb.ClientMessage.SerializeToString,
+        response_deserializer=pb.ServerMessage.FromString,
+    )
+
+    def call(msg):
+        return next(iter(method(iter([msg]), timeout=10, wait_for_ready=True)))
+
+    return channel, call
+
+
+def _ready(cname: str):
+    from fedcrack_tpu.transport import transport_pb2 as pb
+
+    msg = pb.ClientMessage(cname=cname)
+    msg.ready.SetInParent()
+    return msg
+
+
+def _done(cname: str, rnd: int, value: float, ns: int):
+    from fedcrack_tpu.transport import transport_pb2 as pb
+
+    msg = pb.ClientMessage(cname=cname)
+    msg.done.round = rnd
+    msg.done.weights = tree_to_bytes(_vars(value))
+    msg.done.sample_count = ns
+    return msg
+
+
+def _wait_for_statefile(path: str, config: FedConfig, pred, timeout_s: float = 10.0):
+    """Poll the durable snapshot until ``pred(state)`` holds — the drill's
+    kill must land AFTER the update it relies on has been made durable
+    (a real kill races this too; the drill pins the recoverable side)."""
+    from fedcrack_tpu.ckpt import load_state_file
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        state = load_state_file(path, config)
+        if state is not None and pred(state):
+            return state
+        time.sleep(0.01)
+    raise TimeoutError(f"statefile {path} never satisfied the predicate")
+
+
+def run_kill_restart_drill(rounds: int = 3, workdir: str | None = None) -> dict:
+    """The scripted scenario; returns the timing/verification artifact."""
+    from fedcrack_tpu.transport.service import FedServer, ServerThread
+
+    ctx = (
+        tempfile.TemporaryDirectory(prefix="chaos_drill_")
+        if workdir is None
+        else None
+    )
+    base = ctx.name if ctx is not None else workdir
+    try:
+        cfg = FedConfig(
+            max_rounds=rounds,
+            cohort_size=2,
+            registration_window_s=5.0,
+            round_deadline_s=60.0,  # backstop only; the drill never waits it out
+            port=0,
+            state_path=os.path.join(base, "server_state.msgpack"),
+        )
+        t_session = time.perf_counter()
+        server1 = FedServer(cfg, _vars(0.0), tick_period_s=0.02)
+        with ServerThread(server1) as st1:
+            channel, call = _raw_caller(st1.port)
+            assert call(_ready("a")).status == R.SW
+            assert call(_ready("b")).status == R.SW
+            assert call(_done("a", 1, 1.0, 10)).status == R.RESP_ACY
+            channel.close()
+            # The kill must strike after A's update is durable.
+            _wait_for_statefile(
+                cfg.state_path, cfg, lambda s: "a" in s.received
+            )
+            t_kill = time.perf_counter()
+            st1.kill()
+
+        server2 = FedServer(cfg, _vars(0.0), tick_period_s=0.02)
+        resumed = server2.state
+        t_restored = time.perf_counter()
+        if not (
+            resumed.phase == R.PHASE_RUNNING
+            and resumed.current_round == 1
+            and "a" in resumed.received
+            and resumed.cohort == frozenset({"a", "b"})
+        ):
+            raise RuntimeError(
+                f"restart did not resume the round: phase={resumed.phase} "
+                f"round={resumed.current_round} received={sorted(resumed.received)}"
+            )
+        with ServerThread(server2) as st2:
+            channel, call = _raw_caller(st2.port)
+            rep = call(_done("b", 1, 3.0, 30))
+            t_recovered = time.perf_counter()
+            if rep.status != R.RESP_ARY:
+                raise RuntimeError(f"recovery aggregation failed: {rep.status}")
+            # Weighted average over BOTH updates — A's restored from disk:
+            # (10*1 + 30*3) / 40 = 2.5.
+            got = tree_from_bytes(rep.weights)["params"]["w"]
+            avg_exact = bool(np.allclose(got, 2.5, atol=1e-6))
+            for rnd in range(2, rounds + 1):
+                call(_done("a", rnd, 1.0, 10))
+                rep = call(_done("b", rnd, 3.0, 30))
+            channel.close()
+            state = st2.state
+        history_rounds = [h["round"] for h in state.history]
+        return {
+            "rounds": rounds,
+            "restore_s": round(t_restored - t_kill, 4),
+            "kill_to_recover_s": round(t_recovered - t_kill, 4),
+            "session_s": round(time.perf_counter() - t_session, 4),
+            "resumed_mid_round": True,
+            "received_preserved": True,
+            "recovered_avg_exact": avg_exact,
+            "finished": state.phase == R.PHASE_FINISHED,
+            "history_rounds": history_rounds,
+            "history_gapless": history_rounds
+            == list(range(1, len(history_rounds) + 1)),
+        }
+    finally:
+        if ctx is not None:
+            ctx.cleanup()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", required=True)
+    p.add_argument("--rounds", type=int, default=3)
+    args = p.parse_args(argv)
+    artifact = {
+        "generated_by": "fedcrack_tpu.tools.chaos_drill",
+        "kill_restart": run_kill_restart_drill(rounds=args.rounds),
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+    print(json.dumps(artifact["kill_restart"]), flush=True)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,14 +8,19 @@ with a fake trainer and the real TPU trainer plugs in unchanged.
 
 Each control message is one short-lived call on the shared bidi method
 (mirroring the reference's usage pattern of one ``stub.transport(...)`` per
-message). Transient channel errors retry with backoff — the reference
-crashed on any hiccup.
+message). Transient channel errors retry with jittered exponential backoff
+under a per-call retry budget, while non-retryable codes (bad request, bad
+credentials) surface immediately — the reference crashed on any hiccup.
+The retry schedule is exercised under injected flaps and server restarts
+by the chaos suite (``FedClient(chaos=...)`` attaches a
+``fedcrack_tpu.chaos`` fault hook; None in production).
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import random
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -41,6 +46,22 @@ TrainFn = Callable[..., tuple[bytes, int, dict[str, float]]]
 # The reference chunked file uploads at 100 MB (fl_client.py:36); 4 MiB keeps
 # each control message small while still amortizing the per-call overhead.
 DEFAULT_CHUNK_BYTES = 4 * 1024 * 1024
+
+# gRPC codes that a retry can never fix: the request itself is wrong
+# (malformed, unknown method) or the peer has decided about THIS caller
+# (bad credentials, policy). Retrying them on the transient-failure schedule
+# — which the client previously did for every code — just burns the retry
+# budget and hammers the server with requests it already refused.
+NON_RETRYABLE_CODES = frozenset(
+    {
+        grpc.StatusCode.INVALID_ARGUMENT,
+        grpc.StatusCode.UNIMPLEMENTED,
+        grpc.StatusCode.PERMISSION_DENIED,
+        grpc.StatusCode.UNAUTHENTICATED,
+        grpc.StatusCode.FAILED_PRECONDITION,
+        grpc.StatusCode.OUT_OF_RANGE,
+    }
+)
 
 
 def default_cname() -> str:
@@ -68,7 +89,9 @@ class FedClient:
         poll_period_s: float | None = None,
         max_retries: int = 5,
         call_timeout_s: float = 300.0,
+        retry_budget_s: float = 120.0,
         upload_paths: Sequence[str] = (),
+        chaos: Any | None = None,
     ):
         self.config = config
         self.train_fn = train_fn
@@ -93,6 +116,17 @@ class FedClient:
         )
         self.max_retries = max_retries
         self.call_timeout_s = call_timeout_s
+        # Total retry budget per CALL: however the attempt/backoff schedule
+        # is configured, one call never spends more than this much wall
+        # clock retrying (stragglers must eventually fail, not hang).
+        self.retry_budget_s = retry_budget_s
+        # Deterministic per-client jitter source: backoff sleeps are spread
+        # over [0.5, 1.5) x the nominal delay so a cohort knocked over by
+        # one server restart does not stampede back in lockstep.
+        self._jitter = random.Random(self.cname)
+        # Optional fault injector (fedcrack_tpu.chaos.inject.ClientChaos);
+        # None costs one attribute check per call.
+        self._chaos = chaos
 
     # -- wire helpers --
 
@@ -138,8 +172,13 @@ class FedClient:
 
     def _call(self, method, msg: pb.ClientMessage) -> pb.ServerMessage:
         delay = 0.2
+        deadline = time.monotonic() + self.retry_budget_s
         for attempt in range(self.max_retries):
             try:
+                if self._chaos is not None:
+                    # Inside the try: an injected flap takes the same
+                    # except-path a real UNAVAILABLE would.
+                    self._chaos.before_send(self.cname, msg)
                 # wait_for_ready rides out a server that is still importing
                 # JAX / building its global model before binding the port
                 responses = method(
@@ -148,13 +187,24 @@ class FedClient:
                     wait_for_ready=True,
                 )
                 for resp in responses:
+                    if self._chaos is not None:
+                        self._chaos.after_reply(self.cname, msg, resp)
                     return resp
                 raise RuntimeError("stream closed without a reply")
             except grpc.RpcError as e:
-                if attempt == self.max_retries - 1:
+                code = e.code()
+                if code in NON_RETRYABLE_CODES:
+                    # A retry cannot fix these; surface them immediately
+                    # instead of spending the whole schedule re-asking.
                     raise
-                log.warning("rpc failed (%s); retrying in %.1fs", e.code(), delay)
-                time.sleep(delay)
+                sleep_s = delay * (0.5 + self._jitter.random())
+                if (
+                    attempt == self.max_retries - 1
+                    or time.monotonic() + sleep_s > deadline
+                ):
+                    raise
+                log.warning("rpc failed (%s); retrying in %.1fs", code, sleep_s)
+                time.sleep(sleep_s)
                 delay = min(delay * 2, 5.0)
         raise AssertionError("unreachable")
 

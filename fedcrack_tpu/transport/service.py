@@ -92,24 +92,23 @@ def _load_best(path: str) -> dict | None:
 
 def _write_best(path: str, blob: bytes, entry: dict) -> None:
     """Persist the best global model (msgpack bytes) plus a JSON sidecar with
-    the eval metrics that earned it. Each file lands via tmp+rename, so
-    neither is ever torn; the pair is two renames, so the sidecar carries a
-    sha256 of the blob — a crash between the renames is detectable by
-    hashing the model file against its sidecar."""
+    the eval metrics that earned it. Each file lands via the shared atomic
+    writer (write-temp + fsync + rename — a kill between write and rename
+    leaves the old file intact plus an ignorable temp, pinned by the chaos
+    suite), so neither is ever torn; the pair is two renames, so the sidecar
+    carries a sha256 of the blob — a crash between the renames is detectable
+    by hashing the model file against its sidecar."""
     import hashlib as _hashlib
     import json
 
-    d = os.path.dirname(os.path.abspath(path))
-    os.makedirs(d, exist_ok=True)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "wb") as f:
-        f.write(blob)
-    os.replace(tmp, path)
+    from fedcrack_tpu.ioutils import atomic_write_bytes
+
+    atomic_write_bytes(path, blob)
     side = f"{path}.json"
-    tmp = f"{side}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump({**entry, "sha256": _hashlib.sha256(blob).hexdigest()}, f, sort_keys=True)
-    os.replace(tmp, side)
+    payload = json.dumps(
+        {**entry, "sha256": _hashlib.sha256(blob).hexdigest()}, sort_keys=True
+    )
+    atomic_write_bytes(side, payload.encode("utf-8"))
 
 
 def channel_options(max_message_mb: int) -> list[tuple[str, int]]:
@@ -150,6 +149,26 @@ class FedServer:
                     resumed.model_version,
                 )
                 self.state = resumed
+        self._state_path = config.state_path or None
+        if self._state_path is not None:
+            # Mid-round durable state (config.state_path): strictly finer-
+            # grained than the orbax round-boundary checkpoint — it also
+            # holds cohort/phase/received. Prefer it unless the checkpoint
+            # is NEWER (a statefile left over from an older run); at equal
+            # model_version the statefile wins because only it can carry
+            # the current round's already-received updates.
+            from fedcrack_tpu.ckpt import load_state_file
+
+            mid = load_state_file(self._state_path, config)
+            if mid is not None and mid.model_version >= self.state.model_version:
+                log.info(
+                    "resuming mid-round state: round %d, phase %s, "
+                    "%d update(s) already received",
+                    mid.current_round,
+                    mid.phase,
+                    len(mid.received),
+                )
+                self.state = mid
         self._metrics = metrics
         # Per-round evaluation of the freshly aggregated global model
         # (the reference designed this — trainNextRound, fl_server.py:27-37 —
@@ -177,21 +196,63 @@ class FedServer:
         # Serializes checkpoint writes: orbax CheckpointManager is not
         # thread-safe and saves must land in version order.
         self._ckpt_lock = asyncio.Lock()
+        # Statefile snapshots coalesce latest-wins: _apply parks the newest
+        # state in _state_pending and every queued save task drains whatever
+        # is newest WHEN IT RUNS (or nothing, if an earlier task already
+        # wrote it). A burst of N membership/upload changes costs one or two
+        # full-state writes, not N — same durability, no fsync amplification.
+        # The lock serializes the writes themselves; only the event loop
+        # touches _state_pending.
+        self._state_lock = asyncio.Lock()
+        self._state_pending: R.ServerState | None = None
         self._bg_tasks: set[asyncio.Task] = set()
         self._server: grpc.aio.Server | None = None
         self._tick_task: asyncio.Task | None = None
         self.bound_port: int | None = None
         self.finished = asyncio.Event()
+        if self.state.phase == R.PHASE_FINISHED:
+            # A restore can land directly on FINISHED; serve_until_finished
+            # must not wait for an aggregation that will never come.
+            self.finished.set()
 
     # -- state advancement (the only two writers, both under the lock) --
+
+    @staticmethod
+    def _persist_sig(state: R.ServerState) -> tuple:
+        """What a mid-round snapshot must not miss: membership, phase,
+        round/version, and WHICH updates are held. Log-chunk churn is
+        deliberately excluded — snapshotting the whole state per 4 MiB
+        upload chunk would turn the log path into a disk-write amplifier
+        (logs still ride along with the next membership/upload change)."""
+        return (
+            state.phase,
+            state.current_round,
+            state.model_version,
+            tuple(sorted(state.received)),
+            state.cohort,
+            state.departed,
+            state.failed_rounds,
+            tuple(sorted(state.rejected)),
+        )
 
     async def _apply(self, event: R.Event) -> R.Reply:
         async with self._lock:
             prev_version = self.state.model_version
+            prev_sig = (
+                self._persist_sig(self.state) if self._state_path else None
+            )
             self.state, reply = R.transition(self.state, event)
             if self.state.phase == R.PHASE_FINISHED:
                 self.finished.set()
             state = self.state
+        if self._state_path and self._persist_sig(state) != prev_sig:
+            # Durable mid-round state: persisted off the serving path like
+            # the checkpoint — a stalled disk must not freeze the protocol,
+            # and a failed save must not swallow the reply.
+            self._state_pending = state
+            task = asyncio.create_task(self._save_state_file())
+            self._bg_tasks.add(task)
+            task.add_done_callback(self._bg_tasks.discard)
         if self._metrics is not None and state.model_version != prev_version:
             # One structured record per completed round (SURVEY.md §5.5 —
             # the reference printed banners instead). Offloaded like the
@@ -218,6 +279,21 @@ class FedServer:
             self._bg_tasks.add(task)
             task.add_done_callback(self._bg_tasks.discard)
         return reply
+
+    async def _save_state_file(self) -> None:
+        from fedcrack_tpu.ckpt import save_state_file
+
+        async with self._state_lock:
+            state = self._state_pending
+            if state is None:
+                return  # an earlier task already wrote a newer snapshot
+            self._state_pending = None
+            try:
+                await asyncio.to_thread(save_state_file, self._state_path, state)
+            except Exception:
+                log.exception(
+                    "statefile save failed for round %d", state.current_round
+                )
 
     async def _save_checkpoint(self, state: R.ServerState) -> None:
         from fedcrack_tpu.ckpt import save_server_state
@@ -442,6 +518,7 @@ class ServerThread:
         self.loop = asyncio.new_event_loop()
         self.port: int | None = None
         self._started = threading.Event()
+        self._killed = False
         self._thread = threading.Thread(target=self._run, daemon=True)
 
     def _run(self) -> None:
@@ -456,7 +533,38 @@ class ServerThread:
             raise RuntimeError("server failed to start")
         return self
 
+    def kill(self) -> None:
+        """Simulate a process death mid-federation (the chaos harness's
+        server-kill fault): the gRPC ports close with ZERO grace — in-flight
+        client RPCs fail the way they would against a SIGKILLed process —
+        and the loop stops without draining background tasks, so no
+        goodbye checkpoint is written. Durable state is whatever the atomic
+        statefile writer had already renamed. A killed ServerThread's
+        context exit is a no-op; boot a fresh FedServer over the same
+        state/checkpoint paths to model the restart."""
+        if self._killed:
+            return
+        self._killed = True
+
+        def _die():
+            async def seq():
+                try:
+                    if self.server._server is not None:
+                        # 0-grace: abort streams now (a dead process would
+                        # not finish them either); the port must actually
+                        # close so the restarted server can rebind it.
+                        await self.server._server.stop(0)
+                finally:
+                    self.loop.stop()
+
+            asyncio.ensure_future(seq())
+
+        self.loop.call_soon_threadsafe(_die)
+        self._thread.join(timeout=10)
+
     def __exit__(self, *exc) -> None:
+        if self._killed:
+            return
         fut = asyncio.run_coroutine_threadsafe(self.server.stop(grace=0.5), self.loop)
         try:
             fut.result(timeout=5)

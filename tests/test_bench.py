@@ -75,6 +75,17 @@ def test_bench_smoke_emits_driver_contract():
     # they can never under-explain the round).
     assert reconstructed >= host["round_ms"] * 0.98
     assert detail["vs_baseline_compute_only"] > 0
+    # Round-8 chaos-recovery drill: present with verified semantics + real
+    # timings, or a RECORDED budget skip — never silent absence.
+    chaos = detail.get("chaos_recovery")
+    if chaos is not None and "error" not in chaos:
+        assert chaos["resumed_mid_round"] and chaos["received_preserved"]
+        assert chaos["recovered_avg_exact"] and chaos["history_gapless"]
+        assert chaos["restore_s"] >= 0 and chaos["kill_to_recover_s"] > 0
+    else:
+        assert chaos is not None or any(
+            s["section"] == "chaos_recovery" for s in detail["skipped"]
+        )
 
 
 @pytest.mark.slow

@@ -1,0 +1,817 @@
+"""Seeded chaos scenarios: every hardened failure mode, exercised for real.
+
+Each scenario injects a deterministic fault plan (fedcrack_tpu.chaos) into a
+live in-process federation — transport plane (gRPC server + client threads)
+or mesh plane (run_mesh_federation) — and must terminate within a bounded
+wall clock with either a completed federation or a clean recorded abort.
+Zero hangs is the point: the reference system's collect barrier hung
+forever on the FIRST dead client (fl_server.py, SURVEY.md §2.4).
+
+Covered fault types (ISSUE 3 acceptance: >= 8, both planes):
+transport — crash before/during/after upload, straggler past the quorum,
+network flap, corrupt payload, truncated payload, NaN payload, stale-round
+replay, mid-round server kill-and-restart; mesh — injected device failure,
+injected non-finite round output. Plus the torn-write (kill between write
+and rename) sweep for every atomic persistence site.
+"""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fedcrack_tpu.chaos import (
+    CRASH_AFTER_UPLOAD,
+    CRASH_BEFORE_UPLOAD,
+    CRASH_DURING_UPLOAD,
+    CORRUPT_PAYLOAD,
+    NAN_UPDATE,
+    NETWORK_FLAP,
+    STALE_REPLAY,
+    STRAGGLER_DELAY,
+    TRUNCATE_PAYLOAD,
+    ClientChaos,
+    Fault,
+    FaultPlan,
+    InjectedCrash,
+)
+from fedcrack_tpu.configs import FedConfig
+from fedcrack_tpu.fed import rounds as R
+from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
+from fedcrack_tpu.transport import FedClient, FedServer
+from fedcrack_tpu.transport.service import ServerThread
+
+pytestmark = pytest.mark.chaos
+
+# Every scenario must finish WELL inside this; a hang fails loudly instead
+# of eating the suite's budget.
+JOIN_S = 60
+
+
+def _vars(value: float):
+    return {"params": {"w": np.full((4, 4), value, np.float32)}}
+
+
+def _fake_train(increment: float, samples: int):
+    def train_fn(blob: bytes, rnd: int):
+        tree = tree_from_bytes(blob)
+        tree["params"]["w"] = tree["params"]["w"] + increment
+        return tree_to_bytes(tree), samples, {"loss": float(rnd)}
+
+    return train_fn
+
+
+@pytest.fixture
+def cfg():
+    return FedConfig(
+        max_rounds=3,
+        cohort_size=2,
+        registration_window_s=5.0,
+        poll_period_s=0.05,
+        round_deadline_s=0.5,
+        host="127.0.0.1",
+        port=0,
+    )
+
+
+def _run_clients(clients, keys=None):
+    """Run sessions on threads; return {key: SessionResult | Exception}.
+    Bounded join — a hung scenario is an assertion, not a stuck suite."""
+    keys = keys or [c.cname for c in clients]
+    res = {}
+
+    def run(c, key):
+        try:
+            res[key] = c.run_session()
+        except Exception as e:  # noqa: BLE001 — the exception IS the result
+            res[key] = e
+
+    threads = [
+        threading.Thread(target=run, args=(c, k)) for c, k in zip(clients, keys)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=JOIN_S)
+        assert not t.is_alive(), "scenario hung past the wall-clock bound"
+    res["_wall_s"] = time.monotonic() - t0
+    return res
+
+
+def _chaos_client(cfg, port, cname, faults, train=None, **kw):
+    return FedClient(
+        cfg,
+        train or _fake_train(1.0, 10),
+        cname=cname,
+        port=port,
+        chaos=ClientChaos(FaultPlan(faults)),
+        **kw,
+    )
+
+
+# ---------- transport plane: client crash phases ----------
+
+
+def test_crash_before_upload_deadline_rescues(cfg):
+    """The client dies before its round-2 report ever reaches the server;
+    the deadline shrinks the cohort and the survivor finishes alone."""
+    server = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
+    with ServerThread(server) as st:
+        a = FedClient(cfg, _fake_train(1.0, 10), cname="a", port=st.port)
+        b = _chaos_client(
+            cfg, st.port, "b", [Fault(CRASH_BEFORE_UPLOAD, round=2, client="b")]
+        )
+        res = _run_clients([a, b])
+        state = st.state
+    assert isinstance(res["b"], InjectedCrash)
+    assert res["a"].rounds_completed == 3
+    assert state.phase == R.PHASE_FINISHED
+    assert state.cohort == frozenset({"a"})
+    # b's round-1 update DID count before the crash.
+    assert state.history[0]["clients"] == ["a", "b"]
+
+
+@pytest.mark.parametrize("kind", [CRASH_DURING_UPLOAD, CRASH_AFTER_UPLOAD])
+def test_crash_around_upload_restart_rejoins(cfg, kind):
+    """The client dies with its round-1 update already ON the server (during:
+    before it saw the reply; after: on its next call). Its restart under the
+    same cname re-enrolls (SW resync), the pre-crash report is dropped, and
+    the full cohort finishes — no deadline shrink."""
+    cfg = dataclasses.replace(cfg, round_deadline_s=30.0)  # recovery, not shrink
+    server = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
+    with ServerThread(server) as st:
+        a = FedClient(cfg, _fake_train(1.0, 10), cname="a", port=st.port)
+        b1 = _chaos_client(cfg, st.port, "b", [Fault(kind, round=1, client="b")])
+        res = {}
+
+        def run(c, key):
+            try:
+                res[key] = c.run_session()
+            except Exception as e:
+                res[key] = e
+
+        # a's session blocks on b's recovery, so b1 is joined FIRST and the
+        # restart happens while a is still polling.
+        ta = threading.Thread(target=run, args=(a, "a"))
+        tb = threading.Thread(target=run, args=(b1, "b1"))
+        ta.start()
+        tb.start()
+        tb.join(JOIN_S)
+        assert not tb.is_alive(), "crashing client hung"
+        assert isinstance(res["b1"], InjectedCrash)
+        b2 = FedClient(cfg, _fake_train(1.0, 10), cname="b", port=st.port)
+        r_b2 = b2.run_session()
+        ta.join(JOIN_S)
+        assert not ta.is_alive(), "surviving client hung"
+        state = st.state
+    assert not isinstance(res["a"], Exception), res["a"]
+    assert r_b2.enrolled, "restarted cohort member was locked out"
+    assert r_b2.rounds_completed == 3
+    assert state.phase == R.PHASE_FINISHED
+    assert state.cohort == frozenset({"a", "b"})
+    assert [h["round"] for h in state.history] == [1, 2, 3]
+    assert all(h["clients"] == ["a", "b"] for h in state.history)
+
+
+# ---------- transport plane: quorum + straggler ----------
+
+
+def test_quorum_closes_round_and_straggler_resyncs(cfg):
+    """3-client cohort, quorum 2/3: a straggler sleeping past the quorum
+    close must NOT stall the round; its late report is resynced (never
+    averaged) and it rejoins the next round."""
+    cfg = dataclasses.replace(
+        cfg,
+        cohort_size=3,
+        quorum_fraction=2.0 / 3.0,
+        round_deadline_s=30.0,  # quorum, not the deadline, must close rounds
+        max_rounds=2,
+    )
+    server = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
+    with ServerThread(server) as st:
+        fast = [
+            FedClient(cfg, _fake_train(1.0, 8), cname=n, port=st.port)
+            for n in ("a", "b")
+        ]
+        slow = _chaos_client(
+            cfg,
+            st.port,
+            "c",
+            [Fault(STRAGGLER_DELAY, round=1, client="c", delay_s=1.0)],
+            train=_fake_train(5.0, 8),
+        )
+        res = _run_clients(fast + [slow])
+        state = st.state
+    for n in ("a", "b", "c"):
+        assert not isinstance(res[n], Exception), res[n]
+    assert res["a"].rounds_completed == 2 and res["b"].rounds_completed == 2
+    # The straggler ends the session holding the final weights (via FIN or a
+    # resync) — never dead, never hung.
+    assert res["c"].enrolled and res["c"].final_weights is not None
+    assert state.phase == R.PHASE_FINISHED
+    h1 = state.history[0]
+    assert h1["quorum"] == 2 and h1["cohort_size"] == 3
+    # Round 1 aggregated WITHOUT the straggler — the quorum closed it while
+    # c slept, and c's late +5.0 update never entered any average: round-1
+    # weights are exactly the fast clients' +1.0 math.
+    assert h1["clients"] == ["a", "b"]
+    for h in state.history:
+        assert "c" not in h["clients"] or h["round"] > 1
+    final = tree_from_bytes(state.global_blob)["params"]["w"]
+    assert np.all(np.isfinite(final))
+
+
+# ---------- transport plane: poisoned payloads ----------
+
+
+@pytest.mark.parametrize(
+    "kind,reason_frag",
+    [
+        (CORRUPT_PAYLOAD, "undecodable"),
+        (TRUNCATE_PAYLOAD, "undecodable"),
+        (NAN_UPDATE, "non-finite"),
+    ],
+)
+def test_poisoned_update_rejected_and_never_averaged(cfg, kind, reason_frag):
+    """A corrupt/truncated/NaN round-2 payload is REJECTED by sanitation
+    (the poisoned client fails loudly), the federation completes via the
+    deadline shrink, and the global average stays exactly the clean
+    clients' math — the poison never touches FedAvg."""
+    server = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
+    with ServerThread(server) as st:
+        a = FedClient(cfg, _fake_train(1.0, 10), cname="a", port=st.port)
+        b = _chaos_client(
+            cfg, st.port, "b", [Fault(kind, round=2, client="b")],
+            train=_fake_train(3.0, 10),
+        )
+        res = _run_clients([a, b])
+        state = st.state
+    assert isinstance(res["b"], RuntimeError)  # "server rejected update"
+    assert "update rejected" in str(res["b"])
+    assert res["a"].rounds_completed == 3
+    assert state.phase == R.PHASE_FINISHED
+    # Round 1: both (w + (1+3)/2 = 2); rounds 2-3: a alone (+1 each).
+    final = tree_from_bytes(state.global_blob)
+    np.testing.assert_allclose(final["params"]["w"], 2.0 + 1.0 + 1.0, atol=1e-5)
+    rejected = {k: v for h in state.history for k, v in h["rejected"].items()}
+    assert "b" in rejected and reason_frag in rejected["b"]
+
+
+def test_stale_replay_resynced_never_averaged(cfg):
+    """A replayed round-(r-1) report: the server re-syncs the sender to the
+    current round instead of averaging the stale blob or killing the
+    client; the federation completes with exact math."""
+    cfg = dataclasses.replace(cfg, round_deadline_s=30.0)
+    server = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
+    with ServerThread(server) as st:
+        a = FedClient(cfg, _fake_train(1.0, 10), cname="a", port=st.port)
+        # b's round-2 report is re-tagged as round 1 (a replay); b then
+        # resyncs and redoes round 2.
+        b = _chaos_client(
+            cfg, st.port, "b", [Fault(STALE_REPLAY, round=2, client="b")],
+            train=_fake_train(1.0, 10),
+        )
+        res = _run_clients([a, b])
+        state = st.state
+    assert not isinstance(res["a"], Exception), res["a"]
+    assert not isinstance(res["b"], Exception), res["b"]
+    assert res["a"].rounds_completed == 3
+    assert state.phase == R.PHASE_FINISHED
+    assert [h["round"] for h in state.history] == [1, 2, 3]
+    # The replay was logged against the round it intruded on.
+    assert any(
+        "stale round" in h["rejected"].get("b", "") for h in state.history
+    )
+    # Every round's average is exact: +1 per round from each reporter.
+    final = tree_from_bytes(state.global_blob)
+    np.testing.assert_allclose(final["params"]["w"], 3.0, atol=1e-5)
+
+
+# ---------- transport plane: network flap ----------
+
+
+def test_network_flap_ridden_out_by_retries(cfg):
+    """Two consecutive injected UNAVAILABLEs on round 2's calls: the
+    jittered backoff schedule must ride them out with zero protocol
+    damage — full cohort, every round, exact average."""
+    cfg = dataclasses.replace(cfg, round_deadline_s=30.0)  # retries, not shrink
+    server = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
+    with ServerThread(server) as st:
+        a = FedClient(cfg, _fake_train(1.0, 10), cname="a", port=st.port)
+        b = _chaos_client(
+            cfg, st.port, "b",
+            [Fault(NETWORK_FLAP, round=2, client="b", count=2)],
+        )
+        res = _run_clients([a, b])
+        state = st.state
+    assert not isinstance(res["b"], Exception), res["b"]
+    assert res["a"].rounds_completed == 3 and res["b"].rounds_completed == 3
+    assert state.phase == R.PHASE_FINISHED
+    assert all(h["clients"] == ["a", "b"] for h in state.history)
+
+
+def test_retry_budget_and_nonretryable_codes():
+    """Satellite audit pins: a non-retryable code surfaces immediately (one
+    attempt, no schedule burn); the per-call retry budget caps total
+    retry wall-clock even when max_retries would allow more."""
+    import grpc
+
+    from fedcrack_tpu.transport.client import NON_RETRYABLE_CODES
+
+    class FakeErr(grpc.RpcError):
+        def __init__(self, code):
+            self._code = code
+
+        def code(self):
+            return self._code
+
+    calls = {"n": 0}
+
+    def failing_method(it, timeout=None, wait_for_ready=None):
+        calls["n"] += 1
+        raise FakeErr(failing_method.code)
+
+    cfg = FedConfig(port=0)
+    client = FedClient(cfg, _fake_train(1.0, 1), cname="x", max_retries=5)
+
+    assert grpc.StatusCode.INVALID_ARGUMENT in NON_RETRYABLE_CODES
+    failing_method.code = grpc.StatusCode.INVALID_ARGUMENT
+    with pytest.raises(grpc.RpcError):
+        client._call(failing_method, object())
+    assert calls["n"] == 1, "non-retryable code must not be retried"
+
+    # Retryable code: the whole schedule runs (bounded by max_retries)...
+    calls["n"] = 0
+    failing_method.code = grpc.StatusCode.UNAVAILABLE
+    short = FedClient(cfg, _fake_train(1.0, 1), cname="x", max_retries=3)
+    t0 = time.monotonic()
+    with pytest.raises(grpc.RpcError):
+        short._call(failing_method, object())
+    assert calls["n"] == 3
+    # ...with jittered exponential backoff: strictly positive, bounded.
+    assert 0.1 < time.monotonic() - t0 < 10.0
+
+    # Budget cap: a tiny budget stops retrying long before max_retries.
+    calls["n"] = 0
+    tight = FedClient(
+        cfg, _fake_train(1.0, 1), cname="x", max_retries=50, retry_budget_s=0.3
+    )
+    t0 = time.monotonic()
+    with pytest.raises(grpc.RpcError):
+        tight._call(failing_method, object())
+    assert time.monotonic() - t0 < 5.0
+    assert calls["n"] < 50
+
+
+# ---------- transport plane: mid-round server kill-and-restart ----------
+
+
+def test_server_kill_restart_resumes_same_round(tmp_path, cfg):
+    """THE tentpole scenario: the server dies after 1 of 2 round-2 updates
+    landed; the restart resumes the SAME round with the received update
+    intact (identical history prefix), and the federation completes with
+    the exact trajectory an unkilled server would have produced."""
+    cfg = dataclasses.replace(
+        cfg,
+        round_deadline_s=30.0,
+        state_path=str(tmp_path / "server_state.msgpack"),
+    )
+    from fedcrack_tpu.ckpt import load_state_file
+
+    import grpc
+
+    from fedcrack_tpu.transport import transport_pb2 as pb
+    from fedcrack_tpu.transport.service import METHOD, SERVICE_NAME
+
+    def caller(port):
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        method = channel.stream_stream(
+            f"/{SERVICE_NAME}/{METHOD}",
+            request_serializer=pb.ClientMessage.SerializeToString,
+            response_deserializer=pb.ServerMessage.FromString,
+        )
+        return channel, lambda m: next(
+            iter(method(iter([m]), timeout=10, wait_for_ready=True))
+        )
+
+    def ready(cname):
+        m = pb.ClientMessage(cname=cname)
+        m.ready.SetInParent()
+        return m
+
+    def done(cname, rnd, val, ns):
+        m = pb.ClientMessage(cname=cname)
+        m.done.round = rnd
+        m.done.weights = tree_to_bytes(_vars(val))
+        m.done.sample_count = ns
+        return m
+
+    server1 = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
+    with ServerThread(server1) as st1:
+        ch, call = caller(st1.port)
+        assert call(ready("a")).status == R.SW
+        assert call(ready("b")).status == R.SW
+        # Round 1 completes cleanly.
+        assert call(done("a", 1, 1.0, 10)).status == R.RESP_ACY
+        assert call(done("b", 1, 3.0, 30)).status == R.RESP_ARY
+        history_prefix = [dict(h) for h in st1.state.history]
+        # Round 2: only a reports, then the server dies.
+        assert call(done("a", 2, 2.0, 10)).status == R.RESP_ACY
+        ch.close()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            s = load_state_file(cfg.state_path, cfg)
+            if s is not None and "a" in s.received and s.current_round == 2:
+                break
+            time.sleep(0.01)
+        else:
+            pytest.fail("statefile never captured the mid-round update")
+        st1.kill()
+
+    server2 = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
+    # SAME round, same cohort, a's update still held; history prefix intact.
+    assert server2.state.phase == R.PHASE_RUNNING
+    assert server2.state.current_round == 2
+    assert server2.state.cohort == frozenset({"a", "b"})
+    assert set(server2.state.received) == {"a"}
+    assert [dict(h) for h in server2.state.history] == history_prefix
+
+    with ServerThread(server2) as st2:
+        ch, call = caller(st2.port)
+        rep = call(done("b", 2, 4.0, 30))
+        assert rep.status == R.RESP_ARY
+        # The aggregation used a's DISK-RESTORED update:
+        # (10*2 + 30*4) / 40 = 3.5 — bit-for-bit what no kill would give.
+        got = tree_from_bytes(rep.weights)["params"]["w"]
+        np.testing.assert_allclose(got, 3.5, atol=1e-6)
+        # Round 3 completes the federation.
+        call(done("a", 3, 1.0, 10))
+        assert call(done("b", 3, 1.0, 30)).status == R.FIN
+        ch.close()
+        state = st2.state
+    assert state.phase == R.PHASE_FINISHED
+    assert [h["round"] for h in state.history] == [1, 2, 3]
+    assert state.history[0] == history_prefix[0]
+
+
+def test_server_kill_restart_with_live_clients(tmp_path, cfg):
+    """Same kill, but with real FedClient threads mid-flight: their jittered
+    retries must carry them across the restart (same port) and the
+    federation completes without losing a round."""
+    server_state = str(tmp_path / "server_state.msgpack")
+    cfg = dataclasses.replace(
+        cfg, round_deadline_s=30.0, state_path=server_state, max_rounds=2
+    )
+
+    slow_gate = threading.Event()
+    reported = threading.Event()
+
+    def train_a(blob, rnd):
+        return _fake_train(1.0, 10)(blob, rnd)
+
+    def train_b(blob, rnd):
+        if rnd == 2:
+            reported.set()          # b is about to report round 2...
+            slow_gate.wait(JOIN_S)  # ...but waits until the restart happened
+        return _fake_train(3.0, 30)(blob, rnd)
+
+    server1 = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
+    st1 = ServerThread(server1)
+    st1.__enter__()
+    port = st1.port
+    try:
+        a = FedClient(cfg, train_a, cname="a", port=port)
+        b = FedClient(cfg, train_b, cname="b", port=port)
+        res = {}
+
+        def run(c, key):
+            try:
+                res[key] = c.run_session()
+            except Exception as e:
+                res[key] = e
+
+        ta = threading.Thread(target=run, args=(a, "a"))
+        tb = threading.Thread(target=run, args=(b, "b"))
+        ta.start()
+        tb.start()
+        # Wait until round 1 closed and a's round-2 update is durable.
+        from fedcrack_tpu.ckpt import load_state_file
+
+        deadline = time.monotonic() + JOIN_S
+        while time.monotonic() < deadline:
+            s = load_state_file(server_state, cfg)
+            if (
+                s is not None
+                and s.current_round == 2
+                and "a" in s.received
+                and reported.is_set()
+            ):
+                break
+            time.sleep(0.01)
+        else:
+            pytest.fail("never reached the mid-round kill point")
+        st1.kill()
+
+        # Restart on the SAME port (the clients keep dialing it).
+        server2 = FedServer(
+            dataclasses.replace(cfg, port=port), _vars(0.0), tick_period_s=0.05
+        )
+        assert server2.state.current_round == 2
+        assert set(server2.state.received) == {"a"}
+        with ServerThread(server2) as st2:
+            slow_gate.set()
+            ta.join(JOIN_S)
+            tb.join(JOIN_S)
+            assert not ta.is_alive() and not tb.is_alive(), "clients hung"
+            state = st2.state
+    finally:
+        slow_gate.set()
+        st1.kill()  # no-op if already killed
+
+    assert not isinstance(res["a"], Exception), res["a"]
+    assert not isinstance(res["b"], Exception), res["b"]
+    assert state.phase == R.PHASE_FINISHED
+    assert [h["round"] for h in state.history] == [1, 2]
+    # Round 2 averaged a's pre-kill update with b's post-restart one:
+    # round 1 -> w=2.5; round 2 -> (10*3.5 + 30*5.5)/40 = 5.0.
+    final = tree_from_bytes(state.global_blob)
+    np.testing.assert_allclose(final["params"]["w"], 5.0, atol=1e-5)
+
+
+# ---------- torn-write safety (satellite) ----------
+
+
+def test_statefile_kill_between_write_and_rename(tmp_path, cfg):
+    """A crash between temp-write and rename must leave the PREVIOUS
+    snapshot fully readable — the stranded temp file is ignored."""
+    from fedcrack_tpu.ckpt import load_state_file, save_state_file
+
+    cfg = dataclasses.replace(cfg, state_path=str(tmp_path / "state.msgpack"))
+    state = R.initial_state(cfg, _vars(0.0))
+    state, _ = R.transition(state, R.Ready("a", now=0.0))
+    save_state_file(cfg.state_path, state)
+
+    # Simulate the kill: the NEXT snapshot's temp file exists (garbage),
+    # the rename never happened.
+    import os
+
+    with open(f"{cfg.state_path}.tmp.{os.getpid()}", "wb") as f:
+        f.write(b"\x00garbage: killed before rename")
+
+    restored = load_state_file(cfg.state_path, cfg)
+    assert restored is not None
+    assert restored.cohort == frozenset({"a"})
+
+    # And an interrupted atomic_write_bytes (rename raising) leaves the
+    # original intact.
+    from fedcrack_tpu import ioutils
+
+    real_replace = os.replace
+
+    def exploding_replace(src, dst):
+        raise OSError("injected kill at rename")
+
+    os.replace = exploding_replace
+    try:
+        with pytest.raises(OSError):
+            ioutils.atomic_write_bytes(cfg.state_path, b"new bytes")
+    finally:
+        os.replace = real_replace
+    assert load_state_file(cfg.state_path, cfg).cohort == frozenset({"a"})
+
+
+def test_write_best_torn_pair_detected(tmp_path):
+    """_write_best's two-rename pair: a kill between the model rename and
+    the sidecar rename is detected by the sha256 binding and the torn pair
+    is ignored on the next boot (existing semantics, now through the
+    fsync'd atomic writer)."""
+    import json
+
+    from fedcrack_tpu.transport.service import _load_best, _write_best
+
+    best = tmp_path / "best.msgpack"
+    _write_best(str(best), b"model-v1", {"loss": 0.5, "round": 1})
+    assert _load_best(str(best))["loss"] == 0.5
+
+    # Kill between the renames: model file updated, sidecar still v1.
+    from fedcrack_tpu.ioutils import atomic_write_bytes
+
+    atomic_write_bytes(str(best), b"model-v2")
+    assert _load_best(str(best)) is None  # hash mismatch -> torn pair ignored
+    side = json.loads((tmp_path / "best.msgpack.json").read_text())
+    assert side["loss"] == 0.5  # the stale sidecar itself is intact
+
+
+# ---------- mesh plane ----------
+
+
+TINY_KW = dict(
+    img_size=16, stem_features=4, encoder_features=(8,), decoder_features=(8, 4)
+)
+
+
+@pytest.fixture(scope="module")
+def mesh_setup():
+    import jax
+
+    from fedcrack_tpu.configs import ModelConfig
+    from fedcrack_tpu.data.synthetic import synth_crack_batch
+    from fedcrack_tpu.parallel import (
+        build_federated_round,
+        make_mesh,
+        stack_client_data,
+    )
+    from fedcrack_tpu.train.local import create_train_state
+
+    tiny = ModelConfig(**TINY_KW)
+    steps, batch, n_clients = 2, 4, 2
+    mesh = make_mesh(n_clients, 1)
+    round_fn = build_federated_round(mesh, tiny, learning_rate=1e-3, local_epochs=1)
+
+    def data_fn(r):
+        per_client = [
+            synth_crack_batch(steps * batch, img_size=16, seed=10 * r + i)
+            for i in range(n_clients)
+        ]
+        images, masks = stack_client_data(per_client, steps, batch)
+        active = np.ones(n_clients, np.float32)
+        n_samples = np.full(n_clients, float(steps * batch), np.float32)
+        return images, masks, active, n_samples
+
+    def init_vars():
+        return create_train_state(jax.random.key(0), tiny).variables
+
+    return round_fn, mesh, data_fn, init_vars
+
+
+@pytest.fixture(scope="module")
+def clean_two_rounds(mesh_setup):
+    """The unfaulted 2-round reference trajectory both replay tests pin
+    against (computed once — the clean run is the expensive part)."""
+    from fedcrack_tpu.parallel import run_mesh_federation
+
+    round_fn, mesh, data_fn, init_vars = mesh_setup
+    v_clean, _ = run_mesh_federation(round_fn, init_vars(), data_fn, 2, mesh)
+    import jax
+
+    return jax.device_get(v_clean)
+
+
+def _assert_trees_equal(got, want):
+    import jax
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(got), jax.tree_util.tree_leaves(want)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_mesh_kill_and_replay_bit_identical(mesh_setup, clean_two_rounds):
+    """Acceptance pin: device failure at round 0 + NaN corruption at round 1,
+    each absorbed by one replay — the final weights are BIT-identical to
+    the unfaulted run, and the records say exactly what happened."""
+    from fedcrack_tpu.chaos import MESH_DEVICE_FAIL, MESH_NONFINITE, MeshChaos
+    from fedcrack_tpu.parallel import run_mesh_federation
+
+    round_fn, mesh, data_fn, init_vars = mesh_setup
+    plan = FaultPlan(
+        [Fault(MESH_DEVICE_FAIL, round=0), Fault(MESH_NONFINITE, round=1)]
+    )
+    v_chaos, records = run_mesh_federation(
+        round_fn,
+        init_vars(),
+        data_fn,
+        2,
+        mesh,
+        max_round_retries=2,
+        fault_injector=MeshChaos(plan),
+    )
+    _assert_trees_equal(v_chaos, clean_two_rounds)
+    assert [r.retries for r in records] == [1, 1]
+    assert "InjectedDeviceFailure" in records[0].faults[0]
+    assert "NonFiniteRound" in records[1].faults[0]
+    assert not plan.pending  # every scheduled fault actually fired
+
+
+def test_mesh_checkpointer_backed_replay(mesh_setup, clean_two_rounds, tmp_path):
+    """With a FedCheckpointer attached, the replay restores from the durable
+    round boundary (not just the in-memory snapshot) and the trajectory
+    stays identical; the checkpoint itself remains resumable."""
+    from fedcrack_tpu.chaos import MESH_DEVICE_FAIL, MeshChaos
+    from fedcrack_tpu.ckpt import FedCheckpointer
+    from fedcrack_tpu.parallel import run_mesh_federation
+
+    round_fn, mesh, data_fn, init_vars = mesh_setup
+    plan = FaultPlan([Fault(MESH_DEVICE_FAIL, round=1)])
+    with FedCheckpointer(tmp_path / "ckpt") as ckptr:
+        v_chaos, records = run_mesh_federation(
+            round_fn,
+            init_vars(),
+            data_fn,
+            2,
+            mesh,
+            checkpointer=ckptr,
+            max_round_retries=1,
+            fault_injector=MeshChaos(plan),
+        )
+        assert ckptr.latest_version() == 2  # both boundaries checkpointed
+    _assert_trees_equal(v_chaos, clean_two_rounds)
+    assert records[1].retries == 1
+
+
+def test_mesh_retries_exhausted_aborts_cleanly(mesh_setup):
+    """More injected failures than the retry bound: a clean, recorded abort
+    (the exception names the fault) — never a hang, never NaN weights
+    silently returned."""
+    from fedcrack_tpu.chaos import MESH_DEVICE_FAIL, MeshChaos
+    from fedcrack_tpu.chaos.inject import InjectedDeviceFailure
+    from fedcrack_tpu.parallel import run_mesh_federation
+
+    round_fn, mesh, data_fn, init_vars = mesh_setup
+    plan = FaultPlan(
+        [Fault(MESH_DEVICE_FAIL, round=0), Fault(MESH_DEVICE_FAIL, round=0)]
+    )
+    with pytest.raises(InjectedDeviceFailure):
+        run_mesh_federation(
+            round_fn,
+            init_vars(),
+            data_fn,
+            1,
+            mesh,
+            max_round_retries=1,
+            fault_injector=MeshChaos(plan),
+        )
+
+
+# ---------- the long-horizon soak (excluded from tier-1) ----------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_soak_random_fault_schedule(seed, tmp_path):
+    """Many rounds x a seeded random fault schedule over a 3-client cohort:
+    the federation must terminate (complete or cleanly aborted) within the
+    bound, with gapless history and only sanitation-rejected updates
+    missing. Replayable: the failing seed IS the repro."""
+    from fedcrack_tpu.chaos import CLIENT_KINDS
+
+    cfg = FedConfig(
+        max_rounds=6,
+        cohort_size=3,
+        registration_window_s=5.0,
+        poll_period_s=0.05,
+        round_deadline_s=1.5,
+        quorum_fraction=2.0 / 3.0,
+        port=0,
+        state_path=str(tmp_path / f"soak_{seed}.msgpack"),
+    )
+    names = ["a", "b", "c"]
+    plan = FaultPlan.generate(
+        seed,
+        n_rounds=cfg.max_rounds,
+        clients=names,
+        kinds=sorted(CLIENT_KINDS),
+        n_faults=4,
+        max_delay_s=0.4,
+    )
+    # Each client consumes only ITS faults — one hook per thread, no shared
+    # mutable plan across threads.
+    per_client = {
+        n: FaultPlan([f for f in plan.pending if f.client == n]) for n in names
+    }
+    server = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
+    with ServerThread(server) as st:
+        clients = [
+            FedClient(
+                cfg,
+                _fake_train(1.0 + i, 10),
+                cname=n,
+                port=st.port,
+                chaos=ClientChaos(per_client[n]),
+            )
+            for i, n in enumerate(names)
+        ]
+        res = _run_clients(clients)
+        # Crashed clients restart once, like operators restart pods.
+        for n in names:
+            if isinstance(res[n], Exception):
+                retry = FedClient(
+                    cfg, _fake_train(1.0, 10), cname=n, port=st.port
+                )
+                try:
+                    retry.run_session()
+                except Exception:
+                    pass  # a second death is allowed; liveness is the server's
+        deadline = time.monotonic() + JOIN_S
+        while time.monotonic() < deadline and st.state.phase != R.PHASE_FINISHED:
+            time.sleep(0.05)
+        state = st.state
+    assert state.phase == R.PHASE_FINISHED, (
+        f"seed {seed}: federation did not terminate "
+        f"(phase={state.phase}, round={state.current_round})"
+    )
+    rounds = [h["round"] for h in state.history]
+    assert rounds == list(range(1, len(rounds) + 1)), f"gapped history: {rounds}"

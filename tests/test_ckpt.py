@@ -275,3 +275,148 @@ def test_fedopt_moments_survive_restart(tmp_path):
     # without resumed moments the restart would give x2 = 5.0.
     np.testing.assert_allclose(got, want, rtol=1e-6)
     np.testing.assert_allclose(got, 9.5, rtol=1e-6)
+
+
+# ---------- mid-round durable statefile (round 8) ----------
+
+
+class TestStatefile:
+    def _tree(self, v):
+        return {"params": {"w": np.full(3, float(v), np.float32)}}
+
+    def _cfg(self, **kw):
+        defaults = dict(
+            cohort_size=2, max_rounds=3, registration_window_s=100.0
+        )
+        defaults.update(kw)
+        return FedConfig(**defaults)
+
+    def test_roundtrip_preserves_mid_round_state(self, tmp_path):
+        from fedcrack_tpu.ckpt import load_state_file, save_state_file
+
+        cfg = self._cfg()
+        state = R.initial_state(cfg, self._tree(0.0))
+        state, _ = R.transition(state, R.Ready("a", now=0.0))
+        state, _ = R.transition(state, R.Ready("b", now=0.1))
+        blob = tree_to_bytes(self._tree(5.0))
+        state, _ = R.transition(
+            state, R.TrainDone("a", round=1, blob=blob, num_samples=4, now=1.0)
+        )
+        state, _ = R.transition(
+            state, R.LogChunk("a", "tb", b"ev", now=1.5)
+        )
+        path = str(tmp_path / "state.msgpack")
+        save_state_file(path, state)
+        restored = load_state_file(path, cfg)
+        assert restored.phase == R.PHASE_RUNNING
+        assert restored.current_round == 1
+        assert restored.cohort == frozenset({"a", "b"})
+        assert restored.received == {"a": (blob, 4)}
+        assert restored.logs == {"a/tb": b"ev"}
+        # Clock-domain fields never survive: they re-arm on the first event.
+        assert restored.round_started_at is None
+        assert restored.enroll_opened_at is None
+        # ... and the restored machine completes the round bit-for-bit.
+        restored, rep = R.transition(
+            restored,
+            R.TrainDone(
+                "b", round=1, blob=tree_to_bytes(self._tree(7.0)),
+                num_samples=4, now=100.0,
+            ),
+        )
+        assert rep.status == R.RESP_ARY
+        got = tree_from_bytes(restored.global_blob)["params"]["w"]
+        np.testing.assert_allclose(got, 6.0)
+
+    def test_roundtrip_preserves_fedopt_moments(self, tmp_path):
+        """A FedAvgM coordinator's momentum survives the statefile exactly
+        like the orbax path (closed form: x2 = 9.5, not the moment-less
+        5.0)."""
+        from fedcrack_tpu.ckpt import load_state_file, save_state_file
+
+        cfg = self._cfg(
+            cohort_size=1,
+            registration_window_s=1.0,
+            server_optimizer="fedavgm",
+            server_lr=1.0,
+            server_momentum=0.9,
+        )
+        state = R.initial_state(cfg, self._tree(0.0))
+        state, _ = R.transition(state, R.Ready("a", now=0.0))
+        state, _ = R.transition(state, R.Tick(now=2.0))
+        state, _ = R.transition(
+            state,
+            R.TrainDone("a", round=1, blob=tree_to_bytes(self._tree(5.0)),
+                        num_samples=4, now=3.0),
+        )
+        assert state.server_opt_state is not None
+        path = str(tmp_path / "state.msgpack")
+        save_state_file(path, state)
+        restored = load_state_file(path, cfg)
+        assert restored.server_opt_state is not None
+        restored, _ = R.transition(
+            restored,
+            R.TrainDone("a", round=2, blob=tree_to_bytes(self._tree(5.0)),
+                        num_samples=4, now=100.0),
+        )
+        got = tree_from_bytes(restored.global_blob)["params"]["w"]
+        np.testing.assert_allclose(got, 9.5, rtol=1e-6)
+
+    def test_corrupt_statefile_returns_none(self, tmp_path):
+        from fedcrack_tpu.ckpt import load_state_file
+
+        path = tmp_path / "state.msgpack"
+        path.write_bytes(b"\x00 not msgpack at all")
+        assert load_state_file(str(path), self._cfg()) is None
+        assert load_state_file(str(tmp_path / "missing"), self._cfg()) is None
+
+    def test_fedserver_statefile_beats_checkpoint_at_same_version(self, tmp_path):
+        """Both persistence layers populated at model_version 1, the
+        statefile additionally holding round-2's first received update: the
+        boot must pick the statefile (same version -> strictly more state),
+        but a STALE statefile loses to a newer checkpoint."""
+        import asyncio
+
+        from fedcrack_tpu.ckpt import save_state_file
+        from fedcrack_tpu.transport.service import FedServer
+
+        cfg = self._cfg(state_path=str(tmp_path / "state.msgpack"))
+        variables = self._tree(0.0)
+        blob = tree_to_bytes(variables)
+
+        async def run_one_round(server):
+            await server._apply(R.Ready(cname="a", now=0.0))
+            await server._apply(R.Ready(cname="b", now=0.1))
+            rnd = server.state.current_round
+            await server._apply(
+                R.TrainDone(cname="a", round=rnd, blob=blob, num_samples=4, now=1.0)
+            )
+            await server._apply(
+                R.TrainDone(cname="b", round=rnd, blob=blob, num_samples=4, now=1.1)
+            )
+            # round 2 partially collected: a reports, then the "kill"
+            await server._apply(
+                R.TrainDone(cname="a", round=rnd + 1, blob=blob, num_samples=4, now=2.0)
+            )
+            if server._bg_tasks:
+                await asyncio.gather(*tuple(server._bg_tasks))
+
+        with FedCheckpointer(tmp_path / "ckpt") as ckptr:
+            first = FedServer(cfg, variables, checkpointer=ckptr)
+            asyncio.run(run_one_round(first))
+            assert ckptr.latest_version() == 1
+
+            second = FedServer(cfg, variables, checkpointer=ckptr)
+            # Statefile won: same model_version, but mid-round state intact.
+            assert second.state.phase == R.PHASE_RUNNING
+            assert second.state.current_round == 2
+            assert set(second.state.received) == {"a"}
+            assert second.state.cohort == frozenset({"a", "b"})
+
+            # A stale statefile (pre-aggregation snapshot) must LOSE to the
+            # newer checkpoint.
+            stale = R.initial_state(cfg, variables)
+            save_state_file(cfg.state_path, stale)  # model_version 0
+            third = FedServer(cfg, variables, checkpointer=ckptr)
+            assert third.state.model_version == 1
+            assert third.state.phase == R.PHASE_ENROLL  # the orbax semantics

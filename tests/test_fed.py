@@ -540,3 +540,311 @@ class TestBf16Wire:
     def test_rejects_unknown_wire_dtype(self):
         with pytest.raises(ValueError, match="wire_dtype"):
             dataclasses.replace(CFG, wire_dtype="float16")
+
+
+# ---------- quorum aggregation (round 8) ----------
+
+
+class TestQuorum:
+    def _cfg(self, **kw):
+        return dataclasses.replace(CFG, **kw)
+
+    def test_default_full_barrier_unchanged(self):
+        """quorum_fraction=1.0 (default) is the exact pre-quorum barrier."""
+        state = enroll_two(boot())
+        state, ra = done(state, "a", 1, seed=1, now=2.0)
+        assert ra.status == R.RESP_ACY  # 1 of 2 does NOT close the round
+        state, rb = done(state, "b", 1, seed=2, now=3.0)
+        assert rb.status == R.RESP_ARY
+
+    def test_quorum_closes_early_and_history_records_it(self):
+        cfg = self._cfg(cohort_size=4, quorum_fraction=0.5, max_rounds=3)
+        state = boot(cfg)
+        for i, c in enumerate("abcd"):
+            state, _ = R.transition(state, R.Ready(c, now=float(i)))
+        assert state.phase == R.PHASE_RUNNING
+        state, _ = done(state, "a", 1, seed=1, now=5.0)
+        state, r = done(state, "b", 1, seed=2, now=6.0)
+        # 2 of 4 = ceil(0.5 * 4): the round closes NOW.
+        assert r.status == R.RESP_ARY
+        assert state.current_round == 2
+        h = state.history[0]
+        assert h["quorum"] == 2 and h["cohort_size"] == 4
+        assert h["clients"] == ["a", "b"]
+        # The cohort is NOT shrunk — the quorum is not a deadline.
+        assert state.cohort == frozenset("abcd")
+
+    def test_straggler_resynced_logged_never_averaged(self):
+        cfg = self._cfg(cohort_size=2, quorum_fraction=0.5, max_rounds=3)
+        state = enroll_two(boot(cfg))
+        state, r = done(state, "a", 1, seed=1, now=2.0)
+        assert r.status == R.RESP_ARY  # quorum 1-of-2
+        # b's round-1 report arrives after the close: resync, not death.
+        state, r = done(state, "b", 1, seed=2, now=3.0)
+        assert r.status == R.NOT_WAIT
+        assert r.config["current_round"] == 2
+        assert r.blob  # carries the current weights
+        # Round-1 average is a's alone — b's blob never averaged.
+        avg = tree_from_bytes(state.global_blob)
+        assert np.allclose(avg["bias"], _tree(1)["bias"], atol=1e-6)
+        # The stale report is on round 2's record once round 2 closes.
+        state, _ = done(state, "b", 2, seed=3, now=4.0)
+        assert "b" in state.history[-1]["rejected"]
+        assert "stale round" in state.history[-1]["rejected"]["b"]
+
+    def test_future_round_still_rejected(self):
+        state = enroll_two(boot(self._cfg(quorum_fraction=0.5)))
+        state, r = done(state, "a", 7, seed=1, now=2.0)
+        assert r.status == R.REJECTED
+        assert r.config["reason"] == "stale round"
+
+    def test_quorum_fraction_validated(self):
+        with pytest.raises(ValueError, match="quorum_fraction"):
+            self._cfg(quorum_fraction=0.0)
+        with pytest.raises(ValueError, match="quorum_fraction"):
+            self._cfg(quorum_fraction=1.5)
+
+    def test_deadline_still_backstops_below_quorum(self):
+        """Fewer reports than the quorum at the deadline: the shrink still
+        fires (quorum never weakens the deadline)."""
+        cfg = self._cfg(cohort_size=3, quorum_fraction=2.0 / 3.0,
+                        round_deadline_s=10.0, max_rounds=3)
+        state = boot(cfg)
+        for i, c in enumerate("abc"):
+            state, _ = R.transition(state, R.Ready(c, now=float(i)))
+        state, _ = done(state, "a", 1, seed=1, now=3.0)
+        state, _ = R.transition(state, R.Tick(now=50.0))
+        assert state.current_round == 2
+        assert state.cohort == frozenset({"a"})
+        assert state.departed == frozenset({"b", "c"})
+
+
+# ---------- update sanitation (round 8) ----------
+
+
+class TestSanitation:
+    def test_nan_update_rejected_and_logged(self):
+        state = enroll_two(boot())
+        bad = _tree(1)
+        bad["bias"] = np.array([np.nan, 1.0, 2.0, 3.0], np.float32)
+        state, r = R.transition(
+            state,
+            R.TrainDone("a", round=1, blob=tree_to_bytes(bad), num_samples=8, now=2.0),
+        )
+        assert r.status == R.REJECTED
+        assert "non-finite" in r.config["reason"]
+        assert "a" not in state.received
+        assert "non-finite" in state.rejected["a"]
+
+    def test_shape_mismatch_rejected(self):
+        state = enroll_two(boot())
+        bad = _tree(1)
+        bad["bias"] = bad["bias"].reshape(2, 2)  # same size, wrong shape
+        state, r = R.transition(
+            state,
+            R.TrainDone("a", round=1, blob=tree_to_bytes(bad), num_samples=8, now=2.0),
+        )
+        assert r.status == R.REJECTED and "shape" in r.config["reason"]
+
+    def test_truncated_and_garbage_rejected(self):
+        state = enroll_two(boot())
+        good = tree_to_bytes(_tree(1))
+        for blob in (good[: len(good) // 2], b"\x00\xff garbage"):
+            state, r = R.transition(
+                state, R.TrainDone("a", round=1, blob=blob, num_samples=8, now=2.0)
+            )
+            assert r.status == R.REJECTED
+            assert "update rejected" in r.config["reason"]
+
+    def test_negative_sample_count_rejected(self):
+        state = enroll_two(boot())
+        state, r = R.transition(
+            state,
+            R.TrainDone("a", round=1, blob=tree_to_bytes(_tree(1)), num_samples=-4, now=2.0),
+        )
+        assert r.status == R.REJECTED and "negative" in r.config["reason"]
+
+    def test_rejection_lands_in_history_and_round_still_completes(self):
+        state = enroll_two(boot())
+        bad = _tree(1)
+        bad["bias"] = np.full(4, np.inf, np.float32)
+        state, _ = R.transition(
+            state,
+            R.TrainDone("a", round=1, blob=tree_to_bytes(bad), num_samples=8, now=2.0),
+        )
+        # a retries with a clean update; b reports; the round closes clean.
+        state, _ = done(state, "a", 1, seed=1, now=3.0)
+        state, r = done(state, "b", 1, seed=2, now=4.0)
+        assert r.status == R.RESP_ARY
+        h = state.history[0]
+        assert h["clients"] == ["a", "b"]
+        assert "non-finite" in h["rejected"]["a"]
+        assert state.rejected == {}  # per-round map reset after aggregation
+
+    def test_bf16_wire_passes_sanitation(self):
+        cfg = dataclasses.replace(CFG, wire_dtype="bfloat16")
+        state = enroll_two(R.initial_state(cfg, _tree(42)))
+        blob = tree_to_bytes(_tree(1), cast_dtype="bfloat16")
+        state, r = R.transition(
+            state, R.TrainDone("a", round=1, blob=blob, num_samples=8, now=2.0)
+        )
+        assert r.status == R.RESP_ACY  # dtype is not the contract; shape is
+
+    def test_sanitation_can_be_disabled(self):
+        cfg = dataclasses.replace(CFG, sanitize_updates=False)
+        state = enroll_two(R.initial_state(cfg, _tree(42)))
+        bad = _tree(1)
+        bad["bias"] = np.full(4, np.nan, np.float32)
+        state, r = R.transition(
+            state,
+            R.TrainDone("a", round=1, blob=tree_to_bytes(bad), num_samples=8, now=2.0),
+        )
+        assert r.status == R.RESP_ACY  # explicit opt-out admits it
+
+
+# ---------- deadline boundary (round-8 satellite: >= vs > unified) ----------
+
+
+def test_deadline_fires_exactly_at_boundary():
+    """Both time windows close AT the boundary instant: enrollment already
+    used >=, the round deadline previously used > — one tick landing exactly
+    on round_start + deadline must fire the shrink."""
+    cfg = dataclasses.replace(CFG, round_deadline_s=30.0, max_rounds=3,
+                              registration_window_s=10.0)
+    state = boot(cfg)
+    state, _ = R.transition(state, R.Ready("a", now=0.0))
+    state, _ = R.transition(state, R.Ready("b", now=0.0))  # closes at now=0.0
+    assert state.phase == R.PHASE_RUNNING and state.round_started_at == 0.0
+    state, _ = done(state, "a", 1, seed=1, now=1.0)
+    # Exactly AT the deadline: must fire (was: fired only strictly past it).
+    state, _ = R.transition(state, R.Tick(now=30.0))
+    assert state.current_round == 2
+    assert state.cohort == frozenset({"a"})
+    # Symmetry pin: enrollment window also closes exactly at the boundary.
+    s2 = boot(cfg)
+    s2, _ = R.transition(s2, R.Ready("a", now=0.0))
+    s2, _ = R.transition(s2, R.Tick(now=10.0))
+    assert s2.phase == R.PHASE_RUNNING
+
+
+def test_restored_enroll_state_rearms_window():
+    """A statefile-restored ENROLL state with a partial cohort must not sit
+    open forever: enroll_opened_at is None after restore (dead process's
+    clock), and already-enrolled clients never re-send Ready — the window
+    re-arms from the first post-restart event and then closes normally
+    (review finding: previously only round_started_at re-armed)."""
+    cfg = dataclasses.replace(CFG, cohort_size=3, registration_window_s=10.0)
+    state = boot(cfg)
+    state, _ = R.transition(state, R.Ready("a", now=0.0))  # partial cohort
+    restored = state._replace(enroll_opened_at=None, round_started_at=None)
+    # First post-restart event re-arms the window...
+    restored, _ = R.transition(restored, R.Tick(now=500.0))
+    assert restored.phase == R.PHASE_ENROLL
+    assert restored.enroll_opened_at == 500.0
+    # ...which then closes on schedule and the federation proceeds.
+    restored, _ = R.transition(restored, R.Tick(now=510.0))
+    assert restored.phase == R.PHASE_RUNNING
+    assert restored.cohort == frozenset({"a"})
+
+
+def test_restored_running_state_rearms_deadline():
+    """A statefile-restored RUNNING state has no round_started_at (the dead
+    process's clock is meaningless): the first event re-arms it, and the
+    deadline counts from there."""
+    cfg = dataclasses.replace(CFG, round_deadline_s=10.0, max_rounds=3)
+    state = enroll_two(boot(cfg))
+    state, _ = done(state, "a", 1, seed=1, now=2.0)
+    restored = state._replace(round_started_at=None, enroll_opened_at=None)
+    # First post-restart event at t=1000: re-arms, does NOT instantly fire.
+    restored, _ = R.transition(restored, R.Tick(now=1000.0))
+    assert restored.phase == R.PHASE_RUNNING
+    assert restored.round_started_at == 1000.0
+    assert restored.current_round == 1
+    # ... and the deadline then fires 10 s later as usual.
+    restored, _ = R.transition(restored, R.Tick(now=1010.0))
+    assert restored.current_round == 2
+
+
+# ---------- state-machine property test (round-8 satellite) ----------
+
+
+class TestTransitionProperties:
+    """Randomized interleavings from a seed: the liveness invariant (no
+    reachable RUNNING state survives deadline ticks without progress) and
+    structural invariants (gapless history, received ⊆ cohort, round
+    counter == |history| + 1) hold along EVERY path."""
+
+    CLIENTS = ["a", "b", "c", "d"]
+
+    def _random_event(self, rng, state, now):
+        c = rng.choice(self.CLIENTS)
+        kind = rng.randrange(7)
+        if kind == 0:
+            return R.Ready(c, now=now)
+        if kind == 1:
+            return R.PullWeights(c, now=now)
+        if kind == 2:
+            return R.TrainingNotice(c, now=now)
+        if kind == 3:
+            return R.LogChunk(c, "t", b"x" * rng.randrange(1, 64), now=now)
+        if kind == 4:
+            return R.VersionPoll(
+                c, model_version=rng.randrange(4), round=rng.randrange(1, 5), now=now
+            )
+        if kind == 5:
+            return R.Tick(now=now)
+        # TrainDone: mostly-valid round, sometimes-poisoned payload
+        rnd = state.current_round if rng.random() < 0.7 else rng.randrange(1, 6)
+        if rng.random() < 0.25:
+            blob = b"garbage" if rng.random() < 0.5 else tree_to_bytes(
+                {"bias": np.full(4, np.nan, np.float32)}
+            )
+        else:
+            blob = tree_to_bytes(_tree(rng.randrange(100)))
+        return R.TrainDone(c, round=rnd, blob=blob, num_samples=rng.choice([0, 4, 8]), now=now)
+
+    def _check_invariants(self, state):
+        assert set(state.received) <= set(state.cohort)
+        rounds = [h["round"] for h in state.history]
+        assert rounds == list(range(1, len(rounds) + 1)), f"gapped: {rounds}"
+        assert state.current_round == len(state.history) + 1
+        assert not (set(state.cohort) & set(state.departed))
+        if state.phase == R.PHASE_FINISHED:
+            assert state.current_round > state.config.max_rounds
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_interleavings_liveness_and_gapless_history(self, seed):
+        import random as _random
+
+        rng = _random.Random(seed)
+        cfg = dataclasses.replace(
+            CFG,
+            max_rounds=3,
+            cohort_size=rng.choice([2, 3]),
+            registration_window_s=5.0,
+            round_deadline_s=10.0,
+            quorum_fraction=rng.choice([1.0, 0.5, 2.0 / 3.0]),
+        )
+        state = boot(cfg)
+        now = 0.0
+        for _ in range(150):
+            now += rng.uniform(0.0, 2.0)
+            state, reply = R.transition(state, self._random_event(rng, state, now))
+            assert isinstance(reply, R.Reply) and reply.status
+            self._check_invariants(state)
+
+        # Liveness drain: with only Ticks past the deadline, every RUNNING
+        # state must make progress (aggregate, reopen, or finish) — the
+        # machine can never sit in RUNNING forever on an empty event queue.
+        for _ in range(2 * cfg.max_rounds + 4):
+            if state.phase != R.PHASE_RUNNING:
+                break
+            before = (state.current_round, state.phase, state.failed_rounds)
+            now += cfg.round_deadline_s + 1.0
+            state, _ = R.transition(state, R.Tick(now=now))
+            self._check_invariants(state)
+            after = (state.current_round, state.phase, state.failed_rounds)
+            assert after != before, f"seed {seed}: deadline tick made no progress"
+        assert state.phase in (R.PHASE_ENROLL, R.PHASE_FINISHED), (
+            f"seed {seed}: still RUNNING after the drain"
+        )
