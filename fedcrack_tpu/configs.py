@@ -241,6 +241,15 @@ class FedConfig:
     # HBM is ~2 epoch slabs either way; streaming keeps any single
     # transfer 1/K the size and hides more of it under compute.
     segment_overlap: bool = True
+    # Data plane for the mesh rounds (round 9): "streamed" re-stages each
+    # round's shuffled epoch slab (the modes above); "resident" stages the
+    # deduplicated per-client sample pool ONCE (data.pipeline.SamplePool,
+    # sharded P('clients')) and ships only a [clients, epochs, steps,
+    # batch] int32 gather plan per round — kilobytes instead of the epoch
+    # slab, byte-identical trajectory (test-pinned). An HBM guard
+    # (parallel.driver.resident_pool_fits) falls back to the streamed path
+    # when the pool doesn't fit the device.
+    data_placement: str = "streamed"
 
     def __post_init__(self) -> None:
         if self.data.img_size != self.model.img_size:
@@ -254,6 +263,11 @@ class FedConfig:
             raise ValueError(
                 f"segments={self.segments} must divide "
                 f"local_epochs={self.local_epochs} (epoch-grain segmentation)"
+            )
+        if self.data_placement not in ("streamed", "resident"):
+            raise ValueError(
+                "data_placement must be 'streamed' or 'resident', got "
+                f"{self.data_placement!r}"
             )
         if not 0.0 < self.quorum_fraction <= 1.0:
             raise ValueError(

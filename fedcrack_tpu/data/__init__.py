@@ -1,6 +1,7 @@
 from fedcrack_tpu.data.pipeline import (  # noqa: F401
     ArrayDataset,
     CrackDataset,
+    SamplePool,
     as_model_batch,
     dataset_from_source,
     list_pairs,

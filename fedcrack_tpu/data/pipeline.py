@@ -139,6 +139,161 @@ def space_to_depth_images(images: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(x.reshape(*lead, h // 2, w // 2, 4 * c))
 
 
+class SamplePool:
+    """Deduplicated per-client sample pool: the resident data plane's source
+    of truth (round 9).
+
+    The streamed plane re-ships the SAME samples every round in a new
+    shuffle order (``parallel.driver.shuffled_epoch_data`` + per-round
+    restaging) — the bytes on the wire are a permutation of bytes already
+    in HBM. This class keeps the deduplicated pool as a HOST TWIN
+    (``images [C, N, H, W, ch]``, ``masks [C, N, H, W, 1]``, uint8
+    transport canon) and stages it ONCE onto the mesh sharded
+    ``P('clients')``; per round only an ``[C, epochs, steps, batch]``
+    int32 index array ships (kilobytes), and the round program gathers
+    each step's batch on device (``parallel.fedavg_mesh``,
+    ``data_placement="resident"``).
+
+    ``layout="s2d"`` stores the images pre-packed through
+    :func:`space_to_depth_images` (the PR-1 staging twin): gathering from
+    the packed pool is byte-identical to packing the gathered slab, because
+    the packing is per-sample and commutes with sample selection. Masks are
+    never packed (the loss runs at full resolution).
+
+    The host twin is deliberately retained: a chaos/preemption replay
+    (``max_round_retries``) re-stages the pool from it bit-identically,
+    and the HBM-guard fallback assembles streamed epoch slabs from it
+    (:meth:`assemble_round_slab`).
+    """
+
+    LAYOUTS = ("reference", "s2d")
+
+    def __init__(self, images: np.ndarray, masks: np.ndarray, *, layout: str = "reference"):
+        if layout not in self.LAYOUTS:
+            raise ValueError(f"layout must be one of {self.LAYOUTS}, got {layout!r}")
+        images = np.asarray(images)
+        masks = np.asarray(masks)
+        if images.ndim != 5 or masks.ndim != 5:
+            raise ValueError(
+                "SamplePool wants [C, N, H, W, ch] images and [C, N, H, W, 1] "
+                f"masks; got {images.shape} / {masks.shape}"
+            )
+        if images.shape[:2] != masks.shape[:2]:
+            raise ValueError(
+                f"images/masks disagree on [C, N]: {images.shape[:2]} vs "
+                f"{masks.shape[:2]}"
+            )
+        if layout == "s2d":
+            images = space_to_depth_images(images)
+        self.images = np.ascontiguousarray(images)
+        self.masks = np.ascontiguousarray(masks)
+        self.layout = layout
+
+    @classmethod
+    def stack(
+        cls, client_pools: Sequence[tuple[np.ndarray, np.ndarray]], *, layout: str = "reference"
+    ) -> "SamplePool":
+        """Pool from per-client ``(images [N, ...], masks [N, ...])`` pairs.
+        Every client must hold the same N (static shapes — the mesh round
+        is one program over all clients)."""
+        if not client_pools:
+            raise ValueError("no client pools")
+        ns = {p[0].shape[0] for p in client_pools}
+        if len(ns) != 1:
+            raise ValueError(f"clients disagree on pool size: {sorted(ns)}")
+        return cls(
+            np.stack([p[0] for p in client_pools]),
+            np.stack([p[1] for p in client_pools]),
+            layout=layout,
+        )
+
+    @property
+    def n_clients(self) -> int:
+        return self.images.shape[0]
+
+    @property
+    def n_samples(self) -> int:
+        return self.images.shape[1]
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.images.nbytes + self.masks.nbytes)
+
+    def round_indices(
+        self,
+        rngs: Sequence[np.random.Generator],
+        epochs: int,
+        steps: int,
+        batch_size: int,
+    ) -> np.ndarray:
+        """One round's gather plan: ``[C, epochs, steps, batch]`` int32.
+
+        Per client, ONE fresh permutation of the pool per round — drawn
+        exactly like ``parallel.driver.shuffled_epoch_data``
+        (``rng.permutation(n)[:steps*batch]``), then tiled across the
+        epochs axis (the mesh round consumes one epoch slab for all local
+        epochs). Same rng state in, same trajectory out — that equivalence
+        is what makes resident == streamed byte-identical (test-pinned).
+        """
+        if len(rngs) != self.n_clients:
+            raise ValueError(f"{len(rngs)} rngs for {self.n_clients} clients")
+        need = steps * batch_size
+        if self.n_samples < need:
+            raise ValueError(f"pool has {self.n_samples} samples, round needs {need}")
+        per_client = []
+        for rng in rngs:
+            perm = rng.permutation(self.n_samples)[:need].reshape(steps, batch_size)
+            per_client.append(np.broadcast_to(perm, (max(1, epochs), steps, batch_size)))
+        return np.ascontiguousarray(np.stack(per_client).astype(np.int32))
+
+    def assemble_round_slab(self, idx: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Host-assembled ``[C, steps, B, ...]`` epoch slab from a round's
+        index array — the HBM-guard fallback's bridge back to the streamed
+        plane, and the byte-identity test oracle (``pool[idx]`` on host is
+        the same data movement the device gather performs).
+
+        Requires the index array to be constant along the epochs axis (the
+        round layout holds ONE epoch of data; a per-epoch-varying plan has
+        no streamed equivalent)."""
+        idx = np.asarray(idx)
+        if idx.ndim != 4 or idx.shape[0] != self.n_clients:
+            raise ValueError(
+                f"idx must be [C={self.n_clients}, epochs, steps, batch]; got {idx.shape}"
+            )
+        if not (idx == idx[:, :1]).all():
+            raise ValueError(
+                "idx varies across the epochs axis: no streamed-slab equivalent"
+            )
+        e0 = idx[:, 0]  # [C, steps, B]
+        images = np.ascontiguousarray(
+            np.stack([self.images[c][e0[c]] for c in range(self.n_clients)])
+        )
+        masks = np.ascontiguousarray(
+            np.stack([self.masks[c][e0[c]] for c in range(self.n_clients)])
+        )
+        return images, masks
+
+    def stage(self, mesh) -> tuple:
+        """Device placement: one ``device_put`` of each array, sharded
+        ``P('clients')`` over the mesh (replicated over every other axis),
+        barriered until the bytes have landed. Returns the
+        ``(images, masks)`` device pair the resident round programs consume.
+        Re-staging from the retained host twin is bit-identical — the
+        chaos-replay contract."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(mesh, P("clients"))
+        si = jax.device_put(self.images, sharding)
+        sm = jax.device_put(self.masks, sharding)
+        for a in (si, sm):
+            # Element readback = a real transfer barrier even through
+            # remote-device tunnels (see parallel.driver._barrier_read).
+            float(jnp.asarray(a[(0,) * a.ndim], jnp.float32))
+        return si, sm
+
+
 def split_epoch_slab(
     images: np.ndarray, masks: np.ndarray, n_chunks: int
 ) -> tuple[tuple[np.ndarray, ...], tuple[np.ndarray, ...]]:

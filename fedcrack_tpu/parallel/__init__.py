@@ -12,9 +12,11 @@ over the ``batch`` axis (SURVEY.md §5.8, §7 step 5).
 from fedcrack_tpu.parallel.mesh import make_mesh  # noqa: F401
 from fedcrack_tpu.parallel.driver import (  # noqa: F401
     RoundRecord,
+    resident_pool_fits,
     run_mesh_federation,
     shuffled_epoch_data,
     stage_round_data,
+    stage_round_indices,
 )
 from fedcrack_tpu.parallel.fedavg_mesh import (  # noqa: F401
     SegmentedRound,

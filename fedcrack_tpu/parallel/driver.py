@@ -46,11 +46,28 @@ checkpoint when available, else an in-memory snapshot) and replayed,
 bit-identically. The chaos suite drives it through
 ``fault_injector`` (``chaos.inject.MeshChaos``); both knobs are zero-cost
 when off.
+
+Resident data plane (round 9): both staging modes above re-ship the SAME
+samples every round in a new shuffle order — the wire carries a
+permutation of bytes already in HBM, and the staging term of the
+max(compute, staging) roofline is pure waste. ``data_placement="resident"``
+stages a deduplicated ``data.pipeline.SamplePool`` ONCE (sharded
+``P('clients')``) and per round uploads only the ``[C, epochs, steps, B]``
+int32 gather plan (kilobytes); the round program assembles each batch on
+device by ``jnp.take`` — byte-identical to the streamed round over the
+host-assembled slab (test-pinned). Accounting stays honest: the pool is
+charged to the first round's record, every later round's ``staged_bytes``
+is indices only, and ``max_live_staged_bytes`` includes the resident pool.
+An HBM guard (:func:`resident_pool_fits`) falls the federation back to the
+streamed/segment-chunked path — slabs host-assembled from the same pool +
+plan, same trajectory — when the pool doesn't fit; a chaos/preemption
+replay re-stages pool and plan bit-identically from the retained host twin.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Callable, Sequence
 
@@ -59,7 +76,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from fedcrack_tpu.data.pipeline import split_epoch_slab
+from fedcrack_tpu.data.pipeline import SamplePool, split_epoch_slab
 from fedcrack_tpu.parallel.fedavg_mesh import SegmentedRound
 
 CLIENTS, BATCH = "clients", "batch"
@@ -119,6 +136,12 @@ class RoundRecord:
     # "non-finite round output", ...). 0/() on the default path.
     retries: int = 0
     faults: tuple = ()
+    # Which data plane executed this round: "streamed" (per-round epoch-slab
+    # staging) or "resident" (device-resident pool, index-only uploads —
+    # staged_bytes is then the gather plan's bytes after the first round,
+    # which also carries the one-time pool transfer). A federation asked to
+    # run resident but bounced by the HBM guard records "streamed".
+    data_placement: str = "streamed"
 
 
 class NonFiniteRound(RuntimeError):
@@ -169,6 +192,105 @@ def stage_round_data(
     _barrier_read(si)
     _barrier_read(sm)
     return si, sm
+
+
+def stage_round_indices(
+    idx: np.ndarray, mesh: Mesh, seg: SegmentedRound | None = None
+):
+    """Put one round's ``[C, epochs, steps, B]`` int32 gather plan on the
+    mesh (clients sharded, per-step batch split over the ``batch`` axis —
+    the same per-shard batch the streamed slab spec delivers) and barrier.
+
+    For a segmented round the plan is staged as one ``[C, segment_epochs,
+    steps, B]`` array per segment (each ``seg.segment`` call consumes its
+    own slice); monolithic rounds get the single full array. Either way the
+    payload is kilobytes — the entire point of the resident plane."""
+    idx = np.ascontiguousarray(np.asarray(idx, np.int32))
+    sharding = NamedSharding(mesh, P(CLIENTS, None, None, BATCH))
+    if seg is None:
+        out = jax.device_put(idx, sharding)
+        _barrier_read(out)
+        return out
+    se = seg.segment_epochs
+    parts = tuple(
+        jax.device_put(np.ascontiguousarray(idx[:, k * se : (k + 1) * se]), sharding)
+        for k in range(seg.n_segments)
+    )
+    for p in parts:
+        _barrier_read(p)
+    return parts
+
+
+def resident_pool_fits(
+    pool_nbytes: int,
+    mesh: Mesh,
+    *,
+    limit_bytes: int | None = None,
+    safety: float = 0.8,
+) -> tuple[bool, dict]:
+    """HBM guard for the resident data plane: does this pool's per-device
+    share fit alongside the model/carry working set?
+
+    The limit comes from, in order: the explicit ``limit_bytes`` argument,
+    ``FEDCRACK_RESIDENT_HBM_LIMIT_BYTES`` (operator override), or the
+    backend's reported per-device ``bytes_limit`` (TPU). When none is
+    discoverable (CPU backends report nothing useful) the guard PASSES —
+    the fallback exists for devices that can say no, not to veto hosts that
+    can't say anything. ``safety`` reserves headroom for weights, optimizer
+    carry and activations (the guard is deliberately coarse: a wrong "fits"
+    surfaces as an allocator error on the first stage, a wrong "doesn't"
+    only costs the streamed path's staging).
+
+    Returns ``(fits, info)`` where ``info`` records the decision inputs for
+    artifacts/logs."""
+    limit = limit_bytes
+    if limit is None:
+        env = os.environ.get("FEDCRACK_RESIDENT_HBM_LIMIT_BYTES", "")
+        if env:
+            limit = int(env)
+    if limit is None:
+        try:
+            stats = next(iter(mesh.devices.flat)).memory_stats() or {}
+            limit = stats.get("bytes_limit")
+        except Exception:
+            limit = None
+    n_clients = int(mesh.shape[CLIENTS]) if CLIENTS in mesh.shape else 1
+    per_device = -(-int(pool_nbytes) // max(1, n_clients))  # ceil
+    info = {
+        "pool_bytes": int(pool_nbytes),
+        "per_device_bytes": per_device,
+        "limit_bytes": None if limit is None else int(limit),
+        "safety": safety,
+    }
+    if limit is None:
+        info["reason"] = "no per-device memory limit discoverable; assuming fit"
+        return True, info
+    fits = per_device <= safety * limit
+    info["reason"] = (
+        "fits"
+        if fits
+        else f"per-device pool share {per_device} B exceeds "
+        f"{safety:.0%} of the {int(limit)} B device limit"
+    )
+    return fits, info
+
+
+def _assembling_data_fn(pool: SamplePool, data_fn: Callable) -> Callable:
+    """HBM-guard fallback bridge: wrap a resident-contract ``data_fn``
+    (returning ``(idx, active, n_samples)``) into the streamed contract by
+    host-assembling each round's epoch slab from the pool's host twin —
+    ``pool[idx]`` on host is the same data movement the device gather
+    performs, so the fallback trajectory is byte-identical."""
+
+    def wrapped(r):
+        out = data_fn(r)
+        if out is None:
+            return None
+        idx, active, n_samples = out
+        images, masks = pool.assemble_round_slab(np.asarray(idx))
+        return images, masks, active, n_samples
+
+    return wrapped
 
 
 def _delete_staged(chunks: Sequence[jax.Array]) -> None:
@@ -304,6 +426,67 @@ def _run_segmented_round(
     return variables, metrics, out
 
 
+def _run_segmented_round_resident(
+    seg: SegmentedRound,
+    variables: Any,
+    pool_dev: tuple,
+    idx_parts: tuple,
+    host_idx: np.ndarray,
+    active,
+    n_samples,
+    *,
+    data_fn,
+    round_idx: int,
+    n_rounds: int,
+    overlap_staging: bool,
+    mesh: Mesh,
+    acct: dict,
+):
+    """One segmented round on the resident plane: K segment dispatches over
+    the shared device pool, each gathering by its own plan slice. The next
+    round's plan (kilobytes) stages after the first dispatch — there is no
+    slab to stream chunk-by-chunk, which is the point."""
+    out: dict = {
+        "next_buffers": None,
+        "next_cohort": None,
+        "next_bytes": 0,
+        "next_data_s": 0.0,
+        "next_host_idx": None,
+    }
+    timeline: list[dict] = []
+    active, n_samples = seg.check_inputs(pool_dev, active, n_samples, idx=host_idx)
+    carry = seg.init(variables)
+    raw_last = None
+    for k in range(seg.n_segments):
+        td = time.perf_counter()
+        carry, raw_last = seg.segment(carry, variables, pool_dev, idx_parts[k])
+        entry = {
+            "segment": k,
+            "dispatch_s": round(time.perf_counter() - td, 4),
+        }
+        if overlap_staging and round_idx + 1 < n_rounds and k == 0:
+            tdd = time.perf_counter()
+            nxt = data_fn(round_idx + 1)
+            out["next_data_s"] = time.perf_counter() - tdd
+            if nxt is not None:
+                nidx, na, nn = nxt
+                nidx = np.ascontiguousarray(np.asarray(nidx, np.int32))
+                out["next_cohort"] = (na, nn)
+                out["next_host_idx"] = nidx
+                out["next_bytes"] = int(nidx.nbytes)
+                tss = time.perf_counter()
+                out["next_buffers"] = stage_round_indices(nidx, mesh, seg)
+                acct["live"] += out["next_bytes"]
+                acct["round_max"] = max(acct["round_max"], acct["live"])
+                entry["staging_s"] = round(time.perf_counter() - tss, 4)
+                entry["staged_bytes"] = out["next_bytes"]
+        timeline.append(entry)
+    variables, metrics = seg.finalize(carry, variables, active, n_samples, raw_last)
+    out["timeline"] = timeline
+    out["active"], out["n_samples"] = active, n_samples
+    return variables, metrics, out
+
+
 def run_mesh_federation(
     round_fn: Callable,
     variables: Any,
@@ -314,6 +497,10 @@ def run_mesh_federation(
     image_spec: P | None = None,
     overlap_staging: bool = True,
     segment_overlap: bool = True,
+    data_placement: str = "streamed",
+    sample_pool: SamplePool | None = None,
+    streamed_round_fn: Callable | None = None,
+    resident_limit_bytes: int | None = None,
     on_round: Callable[[RoundRecord, Any], None] | None = None,
     checkpointer: Any | None = None,
     start_round: int = 0,
@@ -350,6 +537,29 @@ def run_mesh_federation(
       the bus); ``False`` keeps round-grain staging (the full next slab
       transfers after the first segment dispatch). Ignored for monolithic
       ``round_fn``s.
+    - ``data_placement``: ``"streamed"`` (default — the contracts above) or
+      ``"resident"``: ``round_fn`` must be built with
+      ``data_placement="resident"``, ``sample_pool`` must be the
+      :class:`~fedcrack_tpu.data.pipeline.SamplePool` the plan indexes
+      into, and ``data_fn(r)`` returns ``(idx, active, n_samples)`` where
+      ``idx`` is the round's ``[C, epochs, steps, B]`` int32 gather plan
+      (``SamplePool.round_indices``), or ``None`` to reuse round ``r-1``'s
+      plan. The driver stages the pool ONCE (charged to the first executed
+      round's record), uploads only the plan per round (same
+      overlap/sequential semantics as slab staging), and keeps the pool
+      resident across rounds — per-round ``staged_bytes`` collapses from
+      the epoch slab to the plan's kilobytes. On a retry
+      (``max_round_retries``) pool AND plan are re-staged bit-identically
+      from the retained host twin before the replay.
+    - ``streamed_round_fn`` + ``resident_limit_bytes``: the HBM-guard
+      fallback. When :func:`resident_pool_fits` (against
+      ``resident_limit_bytes``, the ``FEDCRACK_RESIDENT_HBM_LIMIT_BYTES``
+      env override, or the backend's reported per-device limit) says the
+      pool does NOT fit, the federation runs ``streamed_round_fn`` (a
+      streamed-contract round over the same mesh/model) with epoch slabs
+      host-assembled from the pool + plan — byte-identical trajectory,
+      records tagged ``data_placement="streamed"``. With no fallback round
+      provided, an unfittable pool raises instead of guessing.
     - ``on_round(record, variables)``: per-round hook (metrics sinks,
       held-out eval). ``variables`` is the round's output pytree, still on
       device; the hook runs between rounds, so its cost is NOT overlapped
@@ -406,6 +616,42 @@ def run_mesh_federation(
         raise ValueError(
             f"max_round_retries must be >= 0, got {max_round_retries}"
         )
+    if data_placement not in ("streamed", "resident"):
+        raise ValueError(
+            f"data_placement must be 'streamed' or 'resident', got {data_placement!r}"
+        )
+    resident = data_placement == "resident"
+    if resident:
+        if sample_pool is None:
+            raise ValueError("data_placement='resident' needs a sample_pool")
+        if getattr(round_fn, "data_placement", "streamed") != "resident":
+            raise ValueError(
+                "data_placement='resident' needs a round_fn built with "
+                "data_placement='resident' (the gather-assembly data contract)"
+            )
+        fits, guard = resident_pool_fits(
+            sample_pool.nbytes, mesh, limit_bytes=resident_limit_bytes
+        )
+        if not fits:
+            if streamed_round_fn is None:
+                raise RuntimeError(
+                    f"resident sample pool does not fit HBM ({guard['reason']}) "
+                    "and no streamed_round_fn fallback was provided"
+                )
+            if getattr(streamed_round_fn, "data_placement", "streamed") != "streamed":
+                raise ValueError("streamed_round_fn must be a streamed-contract round")
+            # Same pool, same plan, same trajectory — just host-assembled
+            # slabs shipped the old way.
+            round_fn = streamed_round_fn
+            data_fn = _assembling_data_fn(sample_pool, data_fn)
+            resident = False
+    elif getattr(round_fn, "data_placement", "streamed") != "streamed":
+        raise ValueError(
+            "round_fn was built with data_placement='resident' but the driver "
+            "was asked to run streamed — pass data_placement='resident' plus "
+            "the sample_pool (mismatched contracts would feed slabs to a "
+            "gather program)"
+        )
     spec = image_spec if image_spec is not None else P(CLIENTS, None, BATCH)
     seg = round_fn if isinstance(round_fn, SegmentedRound) else None
     hist = list(history)
@@ -417,23 +663,35 @@ def run_mesh_federation(
         raise ValueError(
             f"data_fn({start_round}) returned None: the first round has no data"
         )
-    images, masks, active, n_samples = first
     n_chunks = 1
+    base_bytes = 0  # non-rotating driver-staged bytes (the resident pool)
+    host_idx_cur = None
     ts = time.perf_counter()
-    if seg is not None:
-        n_chunks = seg.n_segments if segment_overlap else 1
-        ic, mc = split_epoch_slab(images, masks, n_chunks)
-        staged_pairs = [stage_round_data(i, m, mesh, spec) for i, m in zip(ic, mc)]
-        si = tuple(p[0] for p in staged_pairs)
-        sm = tuple(p[1] for p in staged_pairs)
+    if resident:
+        idx0, active, n_samples = first
+        host_idx_cur = np.ascontiguousarray(np.asarray(idx0, np.int32))
+        # The pool stages ONCE; it never rotates with the rounds.
+        si = sample_pool.stage(mesh)
+        sm = stage_round_indices(host_idx_cur, mesh, seg)
+        base_bytes = sample_pool.nbytes
+        staged_bytes = base_bytes + int(host_idx_cur.nbytes)
+        cur_bytes = int(host_idx_cur.nbytes)
     else:
-        si, sm = stage_round_data(images, masks, mesh, spec)
+        images, masks, active, n_samples = first
+        if seg is not None:
+            n_chunks = seg.n_segments if segment_overlap else 1
+            ic, mc = split_epoch_slab(images, masks, n_chunks)
+            staged_pairs = [stage_round_data(i, m, mesh, spec) for i, m in zip(ic, mc)]
+            si = tuple(p[0] for p in staged_pairs)
+            sm = tuple(p[1] for p in staged_pairs)
+        else:
+            si, sm = stage_round_data(images, masks, mesh, spec)
+        staged_bytes = int(images.nbytes + masks.nbytes)
+        cur_bytes = staged_bytes
     # Charged to the first executed round's record (boundary-term fix,
     # round 7): the initial transfer is host-blocking in both modes.
     pending_staging_s = time.perf_counter() - ts
-    staged_bytes = int(images.nbytes + masks.nbytes)
-    cur_bytes = staged_bytes
-    acct = {"live": cur_bytes, "round_max": cur_bytes}
+    acct = {"live": base_bytes + cur_bytes, "round_max": base_bytes + cur_bytes}
 
     records: list[RoundRecord] = []
     for r in range(start_round, n_rounds):
@@ -451,6 +709,7 @@ def run_mesh_federation(
             next_bytes = 0
             next_data_s = 0.0
             next_staging_s = 0.0
+            next_host_idx = None
             timeline: list[dict] = []
 
             t0 = time.perf_counter()
@@ -478,14 +737,50 @@ def run_mesh_federation(
                         nxt = data_fn(r + 1)
                         next_data_s = time.perf_counter() - td
                         if nxt is not None:
-                            ni, nm, na, nn = nxt
-                            next_cohort = (na, nn)
-                            next_bytes = int(ni.nbytes + nm.nbytes)
-                            next_buffers = stage_round_data(ni, nm, mesh, spec)
+                            if resident:
+                                nidx, na, nn = nxt
+                                next_host_idx = np.ascontiguousarray(
+                                    np.asarray(nidx, np.int32)
+                                )
+                                next_cohort = (na, nn)
+                                next_bytes = int(next_host_idx.nbytes)
+                                next_buffers = stage_round_indices(
+                                    next_host_idx, mesh, None
+                                )
+                            else:
+                                ni, nm, na, nn = nxt
+                                next_cohort = (na, nn)
+                                next_bytes = int(ni.nbytes + nm.nbytes)
+                                next_buffers = stage_round_data(ni, nm, mesh, spec)
                             acct["live"] += next_bytes
                             acct["round_max"] = max(
                                 acct["round_max"], acct["live"]
                             )
+                elif resident:
+                    out_vars, metrics, segout = _run_segmented_round_resident(
+                        seg,
+                        variables,
+                        si,
+                        sm,
+                        host_idx_cur,
+                        active,
+                        n_samples,
+                        data_fn=data_fn,
+                        round_idx=r,
+                        n_rounds=n_rounds,
+                        overlap_staging=overlap_staging,
+                        mesh=mesh,
+                        acct=acct,
+                    )
+                    if post is not None:
+                        out_vars, metrics = post(out_vars, metrics)
+                    timeline = segout["timeline"]
+                    next_buffers = segout["next_buffers"]
+                    next_cohort = segout["next_cohort"]
+                    next_bytes = segout["next_bytes"]
+                    next_data_s = segout["next_data_s"]
+                    next_host_idx = segout["next_host_idx"]
+                    active, n_samples = segout["active"], segout["n_samples"]
                 else:
                     out_vars, metrics, segout = _run_segmented_round(
                         seg,
@@ -531,13 +826,32 @@ def run_mesh_federation(
                 # Drop whatever of the NEXT round landed during the failed
                 # attempt; the retry re-produces it (deterministic data_fn).
                 if next_buffers is not None:
-                    flat = (
-                        tuple(next_buffers[0]) + tuple(next_buffers[1])
-                        if seg is not None
-                        else next_buffers
-                    )
+                    if resident:
+                        flat = (
+                            next_buffers
+                            if isinstance(next_buffers, tuple)
+                            else (next_buffers,)
+                        )
+                    elif seg is not None:
+                        flat = tuple(next_buffers[0]) + tuple(next_buffers[1])
+                    else:
+                        flat = next_buffers
                     _delete_staged(flat)
-                acct["live"] = cur_bytes
+                acct["live"] = base_bytes + cur_bytes
+                if resident:
+                    # A real preemption may have taken the resident pool
+                    # down with the device: drop the placement and re-stage
+                    # pool AND plan from the retained host twin — bit
+                    # identical (test-pinned), charged to this round's
+                    # staging term.
+                    rs = time.perf_counter()
+                    _delete_staged(
+                        tuple(si)
+                        + (tuple(sm) if isinstance(sm, tuple) else (sm,))
+                    )
+                    si = sample_pool.stage(mesh)
+                    sm = stage_round_indices(host_idx_cur, mesh, seg)
+                    pending_staging_s += time.perf_counter() - rs
                 # Restore the round's input weights: prefer the durable
                 # checkpoint (it IS this round's boundary when present —
                 # a real preemption may have taken the in-memory snapshot
@@ -562,11 +876,19 @@ def run_mesh_federation(
             nxt = data_fn(r + 1)
             next_data_s = time.perf_counter() - td
             if nxt is not None:
-                ni, nm, na, nn = nxt
-                next_cohort = (na, nn)
-                next_bytes = int(ni.nbytes + nm.nbytes)
                 ts = time.perf_counter()
-                if seg is not None:
+                if resident:
+                    nidx, na, nn = nxt
+                    next_host_idx = np.ascontiguousarray(
+                        np.asarray(nidx, np.int32)
+                    )
+                    next_cohort = (na, nn)
+                    next_bytes = int(next_host_idx.nbytes)
+                    next_buffers = stage_round_indices(next_host_idx, mesh, seg)
+                elif seg is not None:
+                    ni, nm, na, nn = nxt
+                    next_cohort = (na, nn)
+                    next_bytes = int(ni.nbytes + nm.nbytes)
                     nic, nmc = split_epoch_slab(ni, nm, n_chunks)
                     pairs = [
                         stage_round_data(ci, cm, mesh, spec)
@@ -577,6 +899,9 @@ def run_mesh_federation(
                         [p[1] for p in pairs],
                     )
                 else:
+                    ni, nm, na, nn = nxt
+                    next_cohort = (na, nn)
+                    next_bytes = int(ni.nbytes + nm.nbytes)
                     next_buffers = stage_round_data(ni, nm, mesh, spec)
                 next_staging_s = time.perf_counter() - ts
                 acct["live"] += next_bytes
@@ -594,6 +919,7 @@ def run_mesh_federation(
             max_live_staged_bytes=acct["round_max"],
             retries=attempt,
             faults=tuple(round_faults),
+            data_placement="resident" if resident else "streamed",
         )
         records.append(record)
         if on_round is not None:
@@ -606,8 +932,13 @@ def run_mesh_federation(
         if next_buffers is not None:
             # The round barrier above guarantees every consumer of the old
             # buffers has run; release them NOW so peak staged HBM stays at
-            # ~2 epoch slabs instead of growing until GC.
-            if seg is not None:
+            # ~2 epoch slabs instead of growing until GC. On the resident
+            # plane only the gather plan rotates — the pool stays put.
+            if resident:
+                _delete_staged(tuple(sm) if isinstance(sm, tuple) else (sm,))
+                sm = next_buffers
+                host_idx_cur = next_host_idx
+            elif seg is not None:
                 _delete_staged(tuple(si) + tuple(sm))
                 si = tuple(next_buffers[0])
                 sm = tuple(next_buffers[1])

@@ -130,7 +130,46 @@ def _epoch_runner(tx, apply_fn, inner_axis, n_inner, anchor, mu_arr, pw_arr):
         }
         return (new_params, new_stats, new_opt_state), metrics
 
-    def run_epochs(carry, chunks, n_epochs):
+    def epoch_reductions(step_metrics):
+        return {
+            "loss": jnp.mean(step_metrics["loss"]),
+            "pixel_acc": jnp.mean(step_metrics["pixel_acc"]),
+            "iou_inter": jnp.sum(step_metrics["iou_inter"]),
+            "iou_union": jnp.sum(step_metrics["iou_union"]),
+        }
+
+    def run_epochs(carry, chunks, n_epochs, idx=None):
+        if idx is not None:
+            # Resident (gather-assembly) mode: `chunks` is the single
+            # ``(pool_images, pool_masks)`` device-resident pool, `idx` the
+            # ``[epochs, steps, B]`` int32 gather plan. Each step jnp.takes
+            # its batch from the pool — pure data movement, so the gathered
+            # batch is byte-identical to the host-assembled slab batch the
+            # streamed path stages (pool[idx] on host == take(pool, idx) on
+            # device) — then runs the SAME sgd_step closure. The epoch scan
+            # consumes one idx row per epoch (epoch-constant rows reproduce
+            # the streamed round's reuse-one-slab-per-epoch semantics).
+            if len(chunks) != 1:
+                raise ValueError("resident mode takes exactly one pool chunk")
+            if idx.shape[0] != n_epochs:
+                raise ValueError(
+                    f"idx carries {idx.shape[0]} epochs, round runs {n_epochs}"
+                )
+            pool_imgs, pool_msks = chunks[0]
+
+            def gather_epoch(carry, epoch_idx):
+                def gather_step(c, step_idx):
+                    batch = (
+                        jnp.take(pool_imgs, step_idx, axis=0),
+                        jnp.take(pool_msks, step_idx, axis=0),
+                    )
+                    return sgd_step(c, batch)
+
+                carry, step_metrics = lax.scan(gather_step, carry, epoch_idx)
+                return carry, epoch_reductions(step_metrics)
+
+            return lax.scan(gather_epoch, carry, idx)
+
         def epoch_body(carry, _):
             parts = []
             for imgs, msks in chunks:
@@ -147,13 +186,7 @@ def _epoch_runner(tx, apply_fn, inner_axis, n_inner, anchor, mu_arr, pw_arr):
                     lambda *xs: jnp.concatenate(xs), *parts
                 )
             )
-            epoch_metrics = {
-                "loss": jnp.mean(step_metrics["loss"]),
-                "pixel_acc": jnp.mean(step_metrics["pixel_acc"]),
-                "iou_inter": jnp.sum(step_metrics["iou_inter"]),
-                "iou_union": jnp.sum(step_metrics["iou_union"]),
-            }
-            return carry, epoch_metrics
+            return carry, epoch_reductions(step_metrics)
 
         return lax.scan(epoch_body, carry, None, length=n_epochs)
 
@@ -205,6 +238,7 @@ def _build_round(
     validate_data,
     pos_weight: float = 1.0,
     remat: bool = False,
+    data_placement: str = "streamed",
 ):
     """Shared core of the one-program federated round.
 
@@ -214,6 +248,16 @@ def _build_round(
     model, or the halo-exchange spatial forward), ``inner_axis`` is the mesh
     axis the client's work is split over (``batch`` or ``space``), and
     ``image_spec`` shards the data accordingly.
+
+    ``data_placement="resident"`` (plain rounds only) swaps the data
+    contract from staged epoch slabs to a device-resident sample pool plus
+    a per-round gather plan: ``round_fn(variables, (pool_images,
+    pool_masks), idx, active, n_samples)`` where the pool pair is
+    ``[C, N, ...]`` sharded ``P('clients')`` and ``idx`` is
+    ``[C, epochs, steps, B]`` int32 with the per-step batch ``B`` split
+    over the inner axis. Each step gathers its batch from the pool on
+    device and runs the identical sgd_step closure, so the round is
+    byte-identical to the streamed round over ``pool[idx]`` (test-pinned).
 
     ``remat=True`` wraps the forward in ``jax.checkpoint``: the backward
     pass recomputes activations instead of keeping the whole U-Net's
@@ -231,10 +275,23 @@ def _build_round(
         apply_fn = jax.checkpoint(apply_fn, prevent_cse=False)
     n_client_shards = mesh.shape[CLIENTS]
     n_inner = mesh.shape[inner_axis]
+    resident = data_placement == "resident"
+    if data_placement not in ("streamed", "resident"):
+        raise ValueError(
+            f"data_placement must be 'streamed' or 'resident', got {data_placement!r}"
+        )
 
-    def client_fit(variables, images, masks, active, n_samples):
+    def client_fit(variables, data_a, data_b, active, n_samples):
         # Per-shard blocks: leading clients-axis block is exactly one client.
-        images, masks = images[0], masks[0]
+        # Streamed: data_a/data_b are the [C, steps, B, ...] epoch slabs.
+        # Resident: data_a is the (pool_images, pool_masks) pair, data_b the
+        # [C, epochs, steps, B] gather plan.
+        if resident:
+            chunk = (data_a[0][0], data_a[1][0])
+            idx = data_b[0]
+        else:
+            chunk = (data_a[0], data_b[0])
+            idx = None
         active_i, n_i = active[0], n_samples[0]
         params = variables["params"]
         batch_stats = variables["batch_stats"]
@@ -254,7 +311,7 @@ def _build_round(
             (params, batch_stats, opt_state),
         )
         carry, per_epoch = run_epochs(
-            carry, [(images, masks)], max(1, local_epochs)
+            carry, [chunk], max(1, local_epochs), idx=idx
         )
         params, batch_stats, _ = carry
 
@@ -278,32 +335,112 @@ def _build_round(
         metrics = jax.tree_util.tree_map(lambda a: a[None], metrics)
         return new_variables, metrics
 
+    if resident:
+        in_specs = (
+            P(),
+            (P(CLIENTS), P(CLIENTS)),  # pool pair: replicated over inner axis
+            _idx_spec(inner_axis),
+            P(CLIENTS),
+            P(CLIENTS),
+        )
+    else:
+        in_specs = (P(), image_spec, image_spec, P(CLIENTS), P(CLIENTS))
     sharded = shard_map(
         client_fit,
         mesh=mesh,
-        in_specs=(P(), image_spec, image_spec, P(CLIENTS), P(CLIENTS)),
+        in_specs=in_specs,
         out_specs=(P(), P(CLIENTS)),
     )
     jitted = jax.jit(sharded)
 
-    def round_fn(variables, images, masks, active, n_samples):
-        if images.shape[0] != n_client_shards:
-            raise ValueError(
-                f"data carries {images.shape[0]} clients, mesh has "
-                f"{n_client_shards} on the '{CLIENTS}' axis"
+    if resident:
+
+        def round_fn(variables, pool, idx, active, n_samples):
+            _check_resident_inputs(
+                pool, idx, n_client_shards, max(1, local_epochs),
+                n_inner, validate_data,
             )
-        validate_data(images)
+            active, n_samples = _host_cohort_check(active, n_samples)
+            return jitted(variables, tuple(pool), idx, active, n_samples)
 
-        # Same contract as fed.algorithms.fedavg: an empty effective cohort
-        # is an error, never a silently-zeroed global model. In a multi-host
-        # job the mask arrives as a cross-process sharded jax.Array whose
-        # global value THIS process cannot fetch — the check then happens
-        # in-mesh instead (all-dropout returns the incoming global model
-        # unchanged; see the `keep` guard in client_fit).
-        active, n_samples = _host_cohort_check(active, n_samples)
-        return jitted(variables, images, masks, active, n_samples)
+    else:
 
+        def round_fn(variables, images, masks, active, n_samples):
+            if images.shape[0] != n_client_shards:
+                raise ValueError(
+                    f"data carries {images.shape[0]} clients, mesh has "
+                    f"{n_client_shards} on the '{CLIENTS}' axis"
+                )
+            validate_data(images)
+
+            # Same contract as fed.algorithms.fedavg: an empty effective
+            # cohort is an error, never a silently-zeroed global model. In a
+            # multi-host job the mask arrives as a cross-process sharded
+            # jax.Array whose global value THIS process cannot fetch — the
+            # check then happens in-mesh instead (all-dropout returns the
+            # incoming global model unchanged; see the `keep` guard in
+            # client_fit).
+            active, n_samples = _host_cohort_check(active, n_samples)
+            return jitted(variables, images, masks, active, n_samples)
+
+    # Drivers key on this tag to refuse a round/data-contract mismatch
+    # before any bytes move (parallel.driver.run_mesh_federation).
+    round_fn.data_placement = data_placement
     return round_fn
+
+
+def _idx_spec(inner_axis: str) -> P:
+    """Sharding of the ``[C, epochs, steps, B]`` gather plan: clients on the
+    leading axis, the per-step batch split over the inner axis — the same
+    per-shard batch the streamed ``P(clients, None, batch)`` slab delivers."""
+    return P(CLIENTS, None, None, inner_axis)
+
+
+def _check_resident_inputs(
+    pool, idx, n_client_shards, epochs, n_inner, validate_data
+) -> None:
+    """Host-side validation of the resident round's data contract."""
+    pool_imgs, pool_msks = pool
+    if pool_imgs.shape[0] != n_client_shards:
+        raise ValueError(
+            f"pool carries {pool_imgs.shape[0]} clients, mesh has "
+            f"{n_client_shards} on the '{CLIENTS}' axis"
+        )
+    if pool_imgs.shape[:2] != pool_msks.shape[:2]:
+        raise ValueError(
+            f"pool images/masks disagree on [C, N]: {pool_imgs.shape[:2]} "
+            f"vs {pool_msks.shape[:2]}"
+        )
+    validate_data(pool_imgs)
+    if idx.ndim != 4 or idx.shape[0] != n_client_shards:
+        raise ValueError(
+            f"idx must be [C={n_client_shards}, epochs, steps, B]; got "
+            f"{tuple(idx.shape)}"
+        )
+    if idx.shape[1] != epochs:
+        raise ValueError(
+            f"idx carries {idx.shape[1]} epochs, the round runs {epochs}"
+        )
+    if idx.shape[-1] % n_inner:
+        raise ValueError(
+            f"per-step batch {idx.shape[-1]} does not divide over the "
+            f"{n_inner}-way inner axis"
+        )
+    # Bounds-check the plan against the pool NOW: jnp.take's in-jit clip
+    # mode would silently clamp an out-of-range index to a valid sample —
+    # training on wrong data where the streamed fallback's numpy gather
+    # raises — and a negative index would clamp to 0 where numpy wraps.
+    # Either way the streamed==resident byte-identity contract breaks
+    # silently; one host-side reduction over the KB-scale plan closes it.
+    if isinstance(idx, jax.Array) and not idx.is_fully_addressable:
+        return  # cross-process plan: this process cannot fetch it to check
+    n_pool = pool_imgs.shape[1]
+    lo, hi = int(np.min(idx)), int(np.max(idx))
+    if lo < 0 or hi >= n_pool:
+        raise ValueError(
+            f"gather plan indexes [{lo}, {hi}] outside the {n_pool}-sample "
+            "pool (jnp.take would silently clamp)"
+        )
 
 
 def _host_cohort_check(active, n_samples):
@@ -359,6 +496,7 @@ def build_federated_round(
     fedprox_mu: float = 0.0,
     pos_weight: float = 1.0,
     remat: bool = False,
+    data_placement: str = "streamed",
 ):
     """Compile-once round function over ``Mesh(('clients', 'batch'))``.
 
@@ -386,6 +524,14 @@ def build_federated_round(
     — same bytes, packed on the host instead of on device); the round
     program consumes either staging layout (pick one per federation — the
     two compile to different programs). Masks stay full-resolution always.
+
+    ``data_placement="resident"`` switches to the gather-assembly data
+    contract (round 9): ``round_fn(variables, (pool_images, pool_masks),
+    idx, active, n_samples)`` over a device-resident
+    ``data.pipeline.SamplePool`` placement and a ``[C, epochs, steps, B]``
+    int32 gather plan — byte-identical to this streamed round over
+    ``pool[idx]`` (test-pinned), at kilobytes of per-round staging instead
+    of the full epoch slab.
     """
     model_config = model_config or ModelConfig()
     _require_axes(mesh, CLIENTS, BATCH)
@@ -402,6 +548,7 @@ def build_federated_round(
         validate_data=validate_channels,
         pos_weight=pos_weight,
         remat=remat,
+        data_placement=data_placement,
     )
 
 
@@ -458,10 +605,23 @@ class SegmentedRound:
     segment_fn: Callable = dataclasses.field(repr=False)
     finalize_fn: Callable = dataclasses.field(repr=False)
     validate_data: Callable = dataclasses.field(repr=False)
+    # "streamed" (staged epoch-slab chunks) or "resident" (device-resident
+    # sample pool + per-segment gather plans — see build_federated_round's
+    # data_placement doc); drivers key on this to match the data contract.
+    data_placement: str = "streamed"
+    n_inner: int = 1
 
-    def check_inputs(self, img_chunks: tuple, active, n_samples):
+    def check_inputs(self, img_chunks, active, n_samples, idx=None):
         """Host-side validation mirroring the monolithic ``round_fn``;
-        returns the (possibly host-viewed) cohort arrays."""
+        returns the (possibly host-viewed) cohort arrays. In resident mode
+        ``img_chunks`` is the ``(pool_images, pool_masks)`` pair and ``idx``
+        the full-round ``[C, local_epochs, steps, B]`` gather plan."""
+        if self.data_placement == "resident":
+            _check_resident_inputs(
+                img_chunks, idx, self.n_client_shards, self.local_epochs,
+                self.n_inner, self.validate_data,
+            )
+            return _host_cohort_check(active, n_samples)
         for c in img_chunks:
             if c.shape[0] != self.n_client_shards:
                 raise ValueError(
@@ -481,7 +641,12 @@ class SegmentedRound:
         ``carry`` is DONATED — the caller must thread the returned carry
         and never reuse the argument. Returns ``(carry, raw_last)`` where
         ``raw_last`` is the segment's last-epoch metric counts ([C] each).
-        """
+        Resident mode: ``img_chunks`` is the pool pair, ``msk_chunks`` the
+        segment's ``[C, segment_epochs, steps, B]`` gather-plan slice."""
+        if self.data_placement == "resident":
+            return self.segment_fn(
+                carry, variables, tuple(img_chunks), msk_chunks
+            )
         return self.segment_fn(
             carry, variables, _as_chunks(img_chunks), _as_chunks(msk_chunks)
         )
@@ -506,6 +671,20 @@ class SegmentedRound:
         return new_variables, metrics
 
     def __call__(self, variables, images, masks, active, n_samples):
+        if self.data_placement == "resident":
+            # images = (pool_images, pool_masks), masks = the full-round
+            # gather plan [C, local_epochs, steps, B]; each segment consumes
+            # its own epochs-axis slice.
+            pool, idx = tuple(images), masks
+            active, n_samples = self.check_inputs(pool, active, n_samples, idx=idx)
+            carry = self.init(variables)
+            raw_last = None
+            se = self.segment_epochs
+            for k in range(self.n_segments):
+                carry, raw_last = self.segment(
+                    carry, variables, pool, idx[:, k * se : (k + 1) * se]
+                )
+            return self.finalize(carry, variables, active, n_samples, raw_last)
         img_chunks, msk_chunks = _as_chunks(images), _as_chunks(masks)
         active, n_samples = self.check_inputs(img_chunks, active, n_samples)
         carry = self.init(variables)
@@ -529,6 +708,7 @@ def _build_round_segments(
     pos_weight: float = 1.0,
     remat: bool = False,
     segments: int = 0,
+    data_placement: str = "streamed",
 ) -> SegmentedRound:
     """Segmented twin of ``_build_round`` (same skeleton, same shared
     ``_epoch_runner``/``_aggregate_and_guard`` closures — see
@@ -538,6 +718,11 @@ def _build_round_segments(
     pw = float(pos_weight)
     if remat:
         apply_fn = jax.checkpoint(apply_fn, prevent_cse=False)
+    if data_placement not in ("streamed", "resident"):
+        raise ValueError(
+            f"data_placement must be 'streamed' or 'resident', got {data_placement!r}"
+        )
+    resident = data_placement == "resident"
     n_client_shards = mesh.shape[CLIENTS]
     n_inner = mesh.shape[inner_axis]
     epochs = max(1, local_epochs)
@@ -566,6 +751,8 @@ def _build_round_segments(
     )
 
     def segment_shard(carry, variables, img_chunks, msk_chunks):
+        # Resident mode: img_chunks is the (pool_images, pool_masks) pair,
+        # msk_chunks the segment's [C, segment_epochs, steps, B] gather plan.
         carry = jax.tree_util.tree_map(lambda x: x[0], carry)
         anchor = variables["params"]  # FedProx anchor = round-start globals
         mu_arr = jnp.asarray(mu, jnp.float32)
@@ -573,19 +760,33 @@ def _build_round_segments(
         run_epochs = _epoch_runner(
             tx, apply_fn, inner_axis, n_inner, anchor, mu_arr, pw_arr
         )
-        chunks = [(i[0], m[0]) for i, m in zip(img_chunks, msk_chunks)]
-        carry, per_epoch = run_epochs(carry, chunks, segment_epochs)
+        if resident:
+            chunks = [(img_chunks[0][0], img_chunks[1][0])]
+            idx = msk_chunks[0]
+        else:
+            chunks = [(i[0], m[0]) for i, m in zip(img_chunks, msk_chunks)]
+            idx = None
+        carry, per_epoch = run_epochs(carry, chunks, segment_epochs, idx=idx)
         last = jax.tree_util.tree_map(lambda a: a[-1], per_epoch)
         return (
             jax.tree_util.tree_map(lambda x: x[None], carry),
             jax.tree_util.tree_map(lambda a: a[None], last),
         )
 
+    if resident:
+        seg_in_specs = (
+            P(CLIENTS),
+            P(),
+            (P(CLIENTS), P(CLIENTS)),
+            _idx_spec(inner_axis),
+        )
+    else:
+        seg_in_specs = (P(CLIENTS), P(), image_spec, image_spec)
     segment_fn = jax.jit(
         shard_map(
             segment_shard,
             mesh=mesh,
-            in_specs=(P(CLIENTS), P(), image_spec, image_spec),
+            in_specs=seg_in_specs,
             out_specs=(P(CLIENTS), P(CLIENTS)),
         ),
         # The previous segment's carry buffers back the next segment's: the
@@ -626,6 +827,8 @@ def _build_round_segments(
         segment_fn=segment_fn,
         finalize_fn=finalize_fn,
         validate_data=validate_data,
+        data_placement=data_placement,
+        n_inner=n_inner,
     )
 
 
@@ -638,11 +841,15 @@ def build_federated_round_segments(
     pos_weight: float = 1.0,
     remat: bool = False,
     segments: int = 0,
+    data_placement: str = "streamed",
 ) -> SegmentedRound:
     """Epoch-segmented variant of :func:`build_federated_round`.
 
-    Same data contract and semantics; ``segments`` (default 0 = one
-    segment per local epoch) must divide ``local_epochs``. ``segments=1``
+    Same data contract and semantics (including ``data_placement`` — in
+    resident mode each segment gathers from the shared device-resident
+    pool by its own epochs-axis slice of the round's gather plan);
+    ``segments`` (default 0 = one segment per local epoch) must divide
+    ``local_epochs``. ``segments=1``
     still differs from the monolithic builder operationally — the carry
     crosses one program boundary and FedAvg runs as a separate finalize
     program — but the result is bit-identical (test-pinned), which makes
@@ -670,6 +877,7 @@ def build_federated_round_segments(
         pos_weight=pos_weight,
         remat=remat,
         segments=segments,
+        data_placement=data_placement,
     )
 
 

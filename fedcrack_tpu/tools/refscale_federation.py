@@ -70,7 +70,7 @@ def _params_l2_diff(a, b) -> float:
 
 def run_refscale_federation(args) -> dict:
     from fedcrack_tpu.configs import ModelConfig
-    from fedcrack_tpu.data.pipeline import ArrayDataset, to_uint8_transport
+    from fedcrack_tpu.data.pipeline import ArrayDataset, SamplePool, to_uint8_transport
     from fedcrack_tpu.data.synthetic import synth_crack_batch
     from fedcrack_tpu.fed.algorithms import (
         apply_server_opt,
@@ -81,8 +81,10 @@ def run_refscale_federation(args) -> dict:
         build_federated_round,
         build_federated_round_segments,
         make_mesh,
+        resident_pool_fits,
         shuffled_epoch_data,
         stage_round_data,
+        stage_round_indices,
     )
     from fedcrack_tpu.train.local import (
         create_train_state,
@@ -97,6 +99,9 @@ def run_refscale_federation(args) -> dict:
     if args.clients < 1:
         raise SystemExit(f"--clients {args.clients} < 1")
     segments = int(getattr(args, "segments", 0) or 0)
+    placement = getattr(args, "data_placement", "streamed") or "streamed"
+    if placement not in ("streamed", "resident"):
+        raise SystemExit(f"--data-placement must be streamed|resident, got {placement!r}")
     ckpt_dir = getattr(args, "ckpt_dir", "") or ""
     resume = bool(getattr(args, "resume", False))
     if resume and not ckpt_dir:
@@ -125,6 +130,44 @@ def run_refscale_federation(args) -> dict:
     )
 
     mesh = make_mesh(1, 1)
+
+    # Held-out eval slab: device-resident ONCE, reused across rounds. Eval
+    # was ~100 s of the round-4 206 s session — dominated by re-shipping the
+    # same eval batches (recalibration + metrics passes) every round; the
+    # batches never change, so stage them once and iterate device arrays.
+    # The one-time transfer is charged to the first round's eval_stage_s;
+    # every later round's is 0.0 (recorded per round in the artifact).
+    t0 = _now()
+    eval_batches = []
+    for bi, bm in eval_ds:
+        di, dm = jax.device_put(bi), jax.device_put(bm)
+        jax.block_until_ready(di)
+        jax.block_until_ready(dm)
+        eval_batches.append((di, dm))
+    pending_eval_stage_s = _now() - t0
+    eval_staged_bytes = int(ev_images.nbytes + ev_masks.nbytes)
+
+    # Resident data plane (round 9): every client's deduplicated pool stays
+    # in HBM for the whole session (they time-share the one chip, so ALL
+    # pools are resident simultaneously — the guard prices the sum); per
+    # fit only the [1, epochs, steps, batch] gather plan ships. Guard
+    # failure falls back to the streamed path, recorded in the artifact.
+    resident = placement == "resident"
+    placement_guard = None
+    sample_pools = staged_pools = None
+    pool_stage_s = 0.0
+    if resident:
+        sample_pools = [SamplePool(pu[None], pmu[None]) for pu, pmu in pools]
+        total_pool_bytes = sum(p.nbytes for p in sample_pools)
+        fits, placement_guard = resident_pool_fits(total_pool_bytes, mesh)
+        if fits:
+            t0 = _now()
+            staged_pools = [p.stage(mesh) for p in sample_pools]
+            pool_stage_s = _now() - t0
+        else:
+            resident = False
+            placement = "streamed"
+
     if segments:
         # Epoch-segmented round: K compiled programs of epochs/K epochs each
         # with a donated device-resident carry — bit-identical to the
@@ -138,6 +181,7 @@ def run_refscale_federation(args) -> dict:
             local_epochs=args.epochs,
             pos_weight=args.pos_weight,
             segments=segments,
+            data_placement="resident" if resident else "streamed",
         )
     else:
         round_fn = build_federated_round(
@@ -146,6 +190,7 @@ def run_refscale_federation(args) -> dict:
             learning_rate=args.lr,
             local_epochs=args.epochs,
             pos_weight=args.pos_weight,
+            data_placement="resident" if resident else "streamed",
         )
     state_tmpl = create_train_state(jax.random.key(args.seed), config)
     rngs = [
@@ -165,9 +210,27 @@ def run_refscale_federation(args) -> dict:
     )
 
     def epoch_for(c: int):
+        """One fit's data draw. Both placements consume EXACTLY one
+        ``rng.permutation(samples)`` per call, so the shuffle schedule —
+        and therefore the trajectory — is placement-independent (and the
+        --resume rng fast-forward stays valid for both)."""
+        if resident:
+            return sample_pools[c].round_indices(
+                [rngs[c]], args.epochs, steps, args.batch
+            )
         return shuffled_epoch_data(
             pools[c][0], pools[c][1], steps, args.batch, rngs[c]
         )
+
+    def stage_for(c: int, epoch_data):
+        """Stage one fit's data; returns (staged_args, staged_bytes) where
+        staged_args are the round_fn data arguments. Resident: the pool is
+        already placed — only the gather plan (kilobytes) ships."""
+        if resident:
+            idx_dev = stage_round_indices(epoch_data, mesh)
+            return (staged_pools[c], idx_dev), int(epoch_data.nbytes)
+        imgs, msks = epoch_data
+        return stage_round_data(imgs, msks, mesh), int(imgs.nbytes + msks.nbytes)
 
     global_vars = state_tmpl.variables
     server_opt_state = (
@@ -210,10 +273,9 @@ def run_refscale_federation(args) -> dict:
         (r, c) for r in range(start_round, args.rounds) for c in range(args.clients)
     ]
     t0 = _now()
-    imgs0, msks0 = epoch_for(schedule[0][1])
+    epoch0 = epoch_for(schedule[0][1])
     shuffle_s = _now() - t0
-    staged = stage_round_data(imgs0, msks0, mesh)
-    staged_bytes = int(imgs0.nbytes + msks0.nbytes)
+    staged, staged_bytes = stage_for(schedule[0][1], epoch0)
 
     client_vars: list = []
     fit_walls: list[float] = []
@@ -232,10 +294,9 @@ def run_refscale_federation(args) -> dict:
         next_bytes = 0
         if k + 1 < len(schedule):
             td = _now()
-            ni, nm = epoch_for(schedule[k + 1][1])
+            nxt_epoch = epoch_for(schedule[k + 1][1])
             next_shuffle_s = _now() - td
-            staged_next = stage_round_data(ni, nm, mesh)
-            next_bytes = int(ni.nbytes + nm.nbytes)
+            staged_next, next_bytes = stage_for(schedule[k + 1][1], nxt_epoch)
 
         # Fit barrier: the metrics depend on every step of the local fit.
         train = {
@@ -303,13 +364,19 @@ def run_refscale_federation(args) -> dict:
             client_vars = []
 
             # Server-side eval of the aggregated global model: BN
-            # recalibration then held-out metrics, at the training pos_weight.
+            # recalibration then held-out metrics, at the training
+            # pos_weight — over the DEVICE-RESIDENT eval batches staged
+            # once before round 1 (eval used to re-ship the same slab every
+            # round, ~100 s of the 206 s round-4 session). eval_stage_s is
+            # the eval-staging paid for THIS round: the one-time transfer
+            # on this process's first round, 0.0 after.
             ev_t0 = _now()
             host_vars = jax.device_get(global_vars)
             st = state_tmpl.replace_variables(host_vars)
-            st = recalibrate_batch_stats(st, eval_ds, config)
-            m = evaluate(st, eval_ds, pos_weight=args.pos_weight)
+            st = recalibrate_batch_stats(st, eval_batches, config)
+            m = evaluate(st, eval_batches, pos_weight=args.pos_weight)
             eval_s = _now() - ev_t0
+            eval_stage_s, pending_eval_stage_s = pending_eval_stage_s, 0.0
 
             rounds_out.append(
                 {
@@ -321,6 +388,10 @@ def run_refscale_federation(args) -> dict:
                     "client_divergence_l2": divergence_l2,
                     "eval": {key: round(float(v), 4) for key, v in m.items()},
                     "eval_s": round(eval_s, 2),
+                    # 6 decimals: the one-time toy-scale staging is sub-ms
+                    # and must stay distinguishable from the 0.0 of later
+                    # rounds (the smoke test pins first>0, rest==0).
+                    "eval_stage_s": round(eval_stage_s, 6),
                 }
             )
             print(json.dumps(rounds_out[-1]), flush=True)
@@ -367,6 +438,10 @@ def run_refscale_federation(args) -> dict:
             "eval_samples": args.eval_samples,
             "segments": segments,
             "server_optimizer": server_kind,
+            # The placement that actually RAN ("resident" may have been
+            # bounced to "streamed" by the HBM guard — see placement_guard).
+            "data_placement": placement,
+            "placement_guard": placement_guard,
             "reference_parity": (
                 "N-client cohort + round barrier + average "
                 "(fl_server.py:59,116-117,92-102); 5 rounds (fl_server.py:18) "
@@ -384,6 +459,16 @@ def run_refscale_federation(args) -> dict:
         "summary": {
             "session_wall_clock_s": round(session_s, 2),
             "synthesis_s": round(synth_s, 2),
+            # Eval slab staged device-resident once (per-round eval_stage_s
+            # carries the one-time transfer on the first round, 0.0 after).
+            "eval_staged_bytes": eval_staged_bytes,
+            # Resident plane one-time costs (0/None when streamed): all
+            # client pools stay in HBM for the session; per-fit staging is
+            # the gather plan only (see fits[].staged_bytes).
+            "pool_bytes_total": (
+                sum(p.nbytes for p in sample_pools) if resident else None
+            ),
+            "pool_stage_s": round(pool_stage_s, 3) if resident else None,
             "round_wall_clock_s_median_post_compile": round(
                 float(np.median(post_compile)), 3
             ),
@@ -433,6 +518,18 @@ def main(argv=None) -> int:
     p.add_argument("--pos-weight", type=float, default=5.0)
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--data-placement",
+        default="streamed",
+        choices=["streamed", "resident"],
+        help="data plane for the mesh fits: 'streamed' restages each fit's "
+        "shuffled epoch slab; 'resident' stages every client's "
+        "deduplicated sample pool once (device-resident for the session) "
+        "and ships only a per-fit int32 gather plan — kilobytes instead "
+        "of the epoch slab, identical trajectory. Falls back to streamed "
+        "(recorded in the artifact) when the HBM guard says the pools "
+        "don't fit",
+    )
     p.add_argument(
         "--segments",
         type=int,

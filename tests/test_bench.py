@@ -12,7 +12,7 @@ import pytest
 
 
 @pytest.mark.slow
-def test_bench_smoke_emits_driver_contract():
+def test_bench_smoke_emits_driver_contract(tmp_path):
     env = dict(os.environ)
     env.update(
         FEDCRACK_BENCH_FORCE_CPU="1",
@@ -20,6 +20,9 @@ def test_bench_smoke_emits_driver_contract():
         FEDCRACK_BENCH_BATCH="4",
         FEDCRACK_BENCH_REPS="1",
         FEDCRACK_BENCH_SIZES="32",
+        # Per-test artifact path: the default is a fixed /tmp file, which
+        # two concurrent bench runs would race on.
+        FEDCRACK_BENCH_OUT=str(tmp_path / "payload.json"),
     )
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run(
@@ -31,8 +34,21 @@ def test_bench_smoke_emits_driver_contract():
         cwd=root,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
-    line = proc.stdout.strip().splitlines()[-1]
-    out = json.loads(line)
+    lines = proc.stdout.strip().splitlines()
+    # Round-9 output contract: the FINAL line is the compact summary (small
+    # enough to survive tail-capture), the full payload is the line before
+    # it and is also written to the artifact path the summary points at.
+    summary = json.loads(lines[-1])
+    assert summary["compact"] is True
+    assert set(summary) >= {"metric", "value", "unit", "vs_baseline", "artifact"}
+    assert summary["unit"] == "ms"
+    assert summary["value"] > 0
+    assert summary["vs_baseline"] > 0
+    out = json.loads(lines[-2])
+    assert out["value"] == summary["value"]
+    if summary["artifact"]:
+        with open(summary["artifact"]) as f:
+            assert json.load(f)["value"] == out["value"]
 
     # The driver's contract: one JSON line with these keys.
     assert set(out) >= {"metric", "value", "unit", "vs_baseline"}
@@ -89,7 +105,7 @@ def test_bench_smoke_emits_driver_contract():
 
 
 @pytest.mark.slow
-def test_bench_budget_skips_sections_but_still_emits():
+def test_bench_budget_skips_sections_but_still_emits(tmp_path):
     """The round-4 budget machinery under the round-5 section order: with an
     already-exhausted budget the mandatory flagship-size sweep still runs and
     the JSON still prints (rc 0), while every optional section — now
@@ -105,6 +121,7 @@ def test_bench_budget_skips_sections_but_still_emits():
         FEDCRACK_BENCH_REPS="1",
         FEDCRACK_BENCH_SIZES="32,48",  # 48 = the optional secondary size
         FEDCRACK_BENCH_BUDGET_S="1",  # exhausted before any optional section
+        FEDCRACK_BENCH_OUT=str(tmp_path / "payload.json"),
     )
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run(
@@ -116,7 +133,10 @@ def test_bench_budget_skips_sections_but_still_emits():
         cwd=root,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
-    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    lines = proc.stdout.strip().splitlines()
+    summary = json.loads(lines[-1])
+    assert summary["compact"] is True and summary["vs_baseline"] is None
+    out = json.loads(lines[-2])
     detail = out["detail"]
     # The mandatory sweep completed and priced the headline value.
     assert set(detail["sweep"]) == {"float32_32", "bfloat16_32"}
@@ -164,6 +184,7 @@ def test_detail_schema_declares_contract_keys():
         "reference_scale",
         "layout_ab",
         "segmented_pipeline",
+        "resident_pool",
     }
     assert required <= set(bench.DETAIL_SCHEMA)
     assert {"round_ms", "round_plus_restage_ms", "staging_hidden_frac"} <= set(
@@ -196,6 +217,12 @@ def test_validate_detail_typed_checks():
                 "segmented": {"round_ms": 7500.0, "staging_hidden_frac": None},
             }
         },
+        "resident_pool": {
+            "bfloat16_128": {
+                "streamed": {"round_ms": 7400.0, "round_plus_restage_ms": 20336.0},
+                "resident": {"round_ms": 7420.0, "round_plus_restage_ms": 7500.0},
+            }
+        },
     }
     assert bench.validate_detail(good) == []
     assert bench.validate_detail({}) == []  # every section is optional
@@ -206,6 +233,67 @@ def test_validate_detail_typed_checks():
         reference_scale={"x": {"staging_hidden_frac": "0.2"}},
     )
     assert any("staging_hidden_frac" in v for v in bench.validate_detail(bad2))
+    bad3 = dict(
+        good,
+        resident_pool={"x": {"resident": {"round_ms": "slow"}}},
+    )
+    assert any("resident_pool" in v for v in bench.validate_detail(bad3))
+
+
+def test_compact_summary_last_line_parses():
+    """Round-9 tail-capture fix: whatever size the full payload grows to,
+    the FINAL stdout line must be a small, self-contained JSON summary —
+    BENCH_r05.json's "parsed": null came from the monolithic payload line
+    being truncated by tail-capture. Exercised without a bench run: a
+    deliberately bloated payload must compact to a bounded line carrying
+    the driver-contract keys."""
+    bench = _import_bench()
+    fat_detail = {k: {} for k in bench.DETAIL_SCHEMA if k != "skipped"}
+    fat_detail["sweep"] = {f"p{i}": {"blob": "x" * 4096} for i in range(64)}
+    fat_detail["skipped"] = [{"section": f"s{i}"} for i in range(16)]
+    payload = {
+        "metric": "m" * 500,
+        "value": 123.4,
+        "unit": "ms",
+        "vs_baseline": 2.5,
+        "detail": fat_detail,
+        "interrupted": "SIGTERM",
+        "schema_violations": ["a", "b"],
+    }
+    line = json.dumps(bench.compact_summary(payload, "/tmp/art.json"))
+    assert len(line) < 4096, f"compact line is {len(line)} bytes"
+    summary = json.loads(line)
+    assert summary["compact"] is True
+    assert set(summary) >= {"metric", "value", "unit", "vs_baseline", "artifact"}
+    assert summary["value"] == 123.4 and summary["artifact"] == "/tmp/art.json"
+    assert "resident_pool" in summary["sections"]
+    assert "detail" not in summary  # the tree is exactly what gets truncated
+    assert summary["skipped_n"] == 16
+    assert summary["interrupted"] == "SIGTERM"
+    assert summary["schema_violations_n"] == 2
+
+
+def test_emit_prints_compact_summary_as_final_line(tmp_path, capsys, monkeypatch):
+    """_emit's stdout contract end to end (in-process): full payload line,
+    then the compact summary as the LAST line, with the full payload also
+    written to the artifact path the summary points at."""
+    bench = _import_bench()
+    art = tmp_path / "payload.json"
+    monkeypatch.setattr(bench, "BENCH_OUT", str(art))
+    bench._set_payload("metric-string", 42.0, 1.5, {"sweep": {}, "skipped": []})
+    bench._emit()
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 2
+    full = json.loads(lines[0])
+    summary = json.loads(lines[-1])
+    assert full["value"] == 42.0 and "detail" in full
+    assert summary["compact"] is True and summary["value"] == 42.0
+    assert summary["artifact"] == str(art)
+    with open(art) as f:
+        assert json.load(f) == full
+    # Idempotence: a signal landing after the normal emit must not double-print.
+    bench._emit()
+    assert capsys.readouterr().out == ""
 
 
 def test_committed_bench_artifacts_satisfy_schema():
