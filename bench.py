@@ -70,6 +70,12 @@ Measurement design (unchanged from round 3, validated in bench_runs/):
    byte-identical batches — the max(compute, staging) roofline collapsing
    to the compute term, with the production driver's RoundRecords pinning
    per-round staged bytes to the gather plan's kilobytes.
+8. **Serving SLO** (round 10, detail.serving): the serving plane
+   (fedcrack_tpu/serve — compiled per-bucket predict, dynamic
+   micro-batching, hot-swap manager, gRPC front door) under tools/load_gen
+   closed-loop traffic across every bucket, with one LIVE hot-swap
+   installed mid-run — throughput img/s, latency p50/p95/p99, swap
+   load/pause, zero-drop accounting.
 
 Output contract (round 9): the full payload prints as one JSON line (value =
 flagship one-program round wall-clock (ms) at reference scale when measured,
@@ -91,7 +97,10 @@ FEDCRACK_BENCH_LAYOUTS=reference,s2d,s2d_full,respack,s2d+respack (layout
 A/B variants; first is the ratio denominator)
 FEDCRACK_BENCH_CHAOS=0 (skip the mid-round kill→restart recovery drill,
 detail.chaos_recovery) FEDCRACK_BENCH_OUT=<full-payload artifact path>
-(default /tmp/fedcrack_bench_payload.json; "" disables the file write).
+(default /tmp/fedcrack_bench_payload.json; "" disables the file write)
+FEDCRACK_BENCH_SERVING=0 (skip the serving-plane section)
+FEDCRACK_BENCH_SERVE_SIZES=128,256 FEDCRACK_BENCH_SERVE_REQUESTS=128
+FEDCRACK_BENCH_SERVE_MAX_BATCH=8 FEDCRACK_BENCH_SERVE_CONCURRENCY=8.
 """
 
 from __future__ import annotations
@@ -144,6 +153,18 @@ DETAIL_SCHEMA: dict = {
     "batch_curve": dict,
     "input_pipeline": dict,
     "chaos_recovery": dict,
+    "serving": dict,
+}
+# Typed keys of detail.serving (round 10): the serving-plane SLO contract —
+# throughput, latency percentiles, zero-drop accounting and the hot-swap
+# record that BASELINE.md "Serving SLO" reads.
+SERVING_SCHEMA: dict = {
+    "throughput_rps": (int, float, type(None)),
+    "latency_ms": dict,
+    "requests": dict,
+    "batcher": dict,
+    "swap": (dict, type(None)),
+    "dropped": int,
 }
 # Per-point keys of detail.reference_scale.* and the per-arm dicts of
 # detail.segmented_pipeline.*: the staging/overlap decomposition contract.
@@ -178,6 +199,13 @@ def validate_detail(detail: dict) -> list:
                 val = (ab.get(arm) or {}).get(key)
                 if val is not None and not isinstance(val, typs):
                     bad.append(f"resident_pool[{name!r}][{arm}][{key!r}]")
+    serving = detail.get("serving")
+    if isinstance(serving, dict) and "error" not in serving:
+        for key, typs in SERVING_SCHEMA.items():
+            if key not in serving:
+                bad.append(f"serving[{key!r}] missing")
+            elif not isinstance(serving[key], typs):
+                bad.append(f"serving[{key!r}]: {type(serving[key]).__name__}")
     return bad
 
 # Default sized from measured section costs on the TPU-tunnel host (round 4):
@@ -202,6 +230,21 @@ COMPILE_EST_S = 60.0
 # tiny weights, seconds — times the durable-statefile crash-recovery path
 # (round 8). "0" opts out.
 CHAOS = os.environ.get("FEDCRACK_BENCH_CHAOS", "1") == "1"
+
+# Serving-plane SLO section (round 10, detail.serving): boots the full
+# serve stack in-process (engine + micro-batcher + hot-swap manager + gRPC
+# front door), drives it with tools/load_gen over >= 2 buckets, installs a
+# live hot-swap at ~1/3 completions, and reports throughput / latency
+# percentiles / swap pause. "0" opts out.
+SERVING = os.environ.get("FEDCRACK_BENCH_SERVING", "1") == "1"
+SERVE_SIZES = tuple(
+    int(s)
+    for s in os.environ.get("FEDCRACK_BENCH_SERVE_SIZES", "128,256").split(",")
+    if s.strip()
+)
+SERVE_REQUESTS = int(os.environ.get("FEDCRACK_BENCH_SERVE_REQUESTS", "128"))
+SERVE_MAX_BATCH = int(os.environ.get("FEDCRACK_BENCH_SERVE_MAX_BATCH", "8"))
+SERVE_CONCURRENCY = int(os.environ.get("FEDCRACK_BENCH_SERVE_CONCURRENCY", "8"))
 
 # Longer-round multiplier for the dispatch-correction fit; the two-point
 # slope needs the rounds to differ, so 2 is the floor.
@@ -1452,6 +1495,133 @@ def _bench_resident_pool(img: int, dtype: str, device, mesh, reuse: dict, mono_p
     return out
 
 
+def _bench_serving(device) -> dict:
+    """Serving-plane SLO measurement (round 10, detail.serving).
+
+    The full production stack in one process: ``InferenceEngine`` (one
+    compiled program per bucket), ``MicroBatcher`` (dynamic micro-batching),
+    ``ModelVersionManager`` (hot swap), the gRPC ``ServePlane/Predict``
+    front door, and ``tools/load_gen`` driving it closed-loop over every
+    bucket size. At ~1/3 completions a new model version is installed
+    through the manager (the request-boundary barrier) — ``swap`` records
+    the load cost and the served-plane pause, and ``versions_observed``
+    proves the swap was live mid-run. Weights are seed-initialized: serving
+    throughput/latency are weight-independent, and the swap semantics are
+    what the section certifies (bit-identity is test-pinned in
+    tests/test_serve.py, not re-proven here).
+    """
+    import dataclasses
+
+    from fedcrack_tpu.configs import ModelConfig, ServeConfig
+    from fedcrack_tpu.models.resunet import init_variables
+    from fedcrack_tpu.serve import (
+        InferenceEngine,
+        MicroBatcher,
+        ModelVersionManager,
+        ServeServer,
+        ServeServerThread,
+        ServeService,
+    )
+    from fedcrack_tpu.tools.load_gen import run_load
+
+    dtype = "bfloat16" if getattr(device, "platform", "") == "tpu" else "float32"
+    serve_config = ServeConfig(
+        bucket_sizes=tuple(sorted(SERVE_SIZES)),
+        max_batch=SERVE_MAX_BATCH,
+        max_delay_ms=5.0,
+        tile_overlap=min(16, min(SERVE_SIZES) - 16) if min(SERVE_SIZES) > 16 else 0,
+        compute_dtype=dtype,
+        port=0,
+    )
+    model_config = ModelConfig(img_size=max(SERVE_SIZES), compute_dtype=dtype)
+    var_v0 = init_variables(jax.random.key(SEED), model_config)
+    var_v1 = init_variables(jax.random.key(SEED + 1), model_config)
+
+    t0 = time.perf_counter()
+    engine = InferenceEngine(model_config, serve_config)
+    manager = ModelVersionManager(engine, var_v0, initial_version=0)
+    engine.warmup(manager.snapshot()[1])
+    warmup_s = time.perf_counter() - t0
+
+    batcher = MicroBatcher(engine, manager)
+    server = ServeServer(ServeService(engine, batcher, manager), port=0)
+    swap_at = max(1, SERVE_REQUESTS // 3)
+    state = {"fired": False, "n": 0}
+
+    def on_complete():
+        state["n"] += 1
+        if not state["fired"] and state["n"] >= swap_at:
+            state["fired"] = True
+            # Direct install (pre-decoded weights): the statefile/checkpoint
+            # READ path is unit-tested; paying a multi-second msgpack decode
+            # under the load's GIL here would only blur the swap timing.
+            manager.install(1, var_v1)
+
+    try:
+        with ServeServerThread(server) as thread:
+            summary = run_load(
+                f"127.0.0.1:{thread.port}",
+                mode="closed",
+                n_requests=SERVE_REQUESTS,
+                concurrency=SERVE_CONCURRENCY,
+                sizes=serve_config.bucket_sizes,
+                seed=SEED,
+                on_complete=on_complete,
+            )
+    finally:
+        batcher.close()
+        manager.stop()
+
+    stats = batcher.stats()
+    swap = None
+    if manager.last_swap is not None:
+        gaps = stats.get("swap_gaps_ms") or []
+        swap = {
+            **manager.last_swap,
+            "gap_ms": gaps[0] if gaps else None,
+            "triggered_after_n": swap_at,
+        }
+    # Throughput in images/s == requests/s here (one image per request);
+    # recomputed over the serving phase only via the load_gen wall.
+    return {
+        "dtype": dtype,
+        "buckets": list(serve_config.bucket_sizes),
+        "max_batch": serve_config.max_batch,
+        "max_delay_ms": serve_config.max_delay_ms,
+        "concurrency": SERVE_CONCURRENCY,
+        "warmup_s": round(warmup_s, 3),
+        "requests": {
+            "total": summary["n_requests"],
+            "completed": summary["completed"],
+            "rejected": summary["rejected"],
+            "per_size": summary["per_size"],
+            "versions_observed": summary["versions_observed"],
+        },
+        "dropped": summary["dropped"],
+        "throughput_rps": summary["throughput_rps"],
+        "wall_s": summary["wall_s"],
+        "latency_ms": summary["latency_ms"],
+        "server_latency_ms": summary["server_latency_ms"],
+        "batcher": {
+            k: stats[k]
+            for k in (
+                "batches",
+                "batch_retries",
+                "deadline_missed",
+                "per_bucket",
+                "versions_served",
+            )
+        },
+        "swap": swap,
+        "note": (
+            "closed-loop gRPC load over every bucket; one live hot-swap "
+            "installed mid-run at the request-boundary barrier — "
+            "versions_observed spanning two versions with dropped == 0 is "
+            "the serve-while-training claim"
+        ),
+    }
+
+
 def main() -> None:
     # Smoke-test hook: this image pre-imports jax at interpreter startup with
     # the axon (real TPU tunnel) platform, so a JAX_PLATFORMS=cpu env override
@@ -1760,6 +1930,34 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
             )
         detail["budget"] = _budget_detail()
         _set_payload(metric_headline, value, vs_baseline, detail)
+
+    # ---- serving plane (round 10): the full serve stack (compiled buckets,
+    # micro-batcher, hot-swap manager, gRPC front door) under closed-loop
+    # load with one live hot-swap — THIS round's deliverable, so it runs
+    # right after the reference-scale headline ----
+    if SERVING:
+        serve_est = (
+            2 * COMPILE_EST_S
+            + SERVE_REQUESTS * 0.3
+            + _est_synth_s(
+                sum(
+                    s * s * 16 * (SERVE_REQUESTS // max(1, len(SERVE_SIZES)) + 1)
+                    for s in SERVE_SIZES
+                )
+            )
+            + 15.0
+        )
+        if _fits(serve_est):
+            t0 = time.monotonic()
+            try:
+                detail["serving"] = _bench_serving(device)
+            except Exception as e:  # the serving extra must never kill the artifact
+                detail["serving"] = {"error": repr(e)}
+            section_s["serving"] = time.monotonic() - t0
+            detail["budget"] = _budget_detail()
+            _set_payload(metric_headline, value, vs_baseline, detail)
+        else:
+            _skip(skips, "serving", serve_est, "estimate exceeds remaining budget")
 
     # ---- layout A/B (round 6): the VERDICT r5 top ask — space-to-depth /
     # channel-packing graph transforms vs the reference layout, interleaved,

@@ -5,8 +5,10 @@ The reference system hangs forever when any client dies mid-round
 the opposite claim TESTABLE: every failure mode the port hardens against is
 a seeded, replayable chaos scenario — client crashes at each upload phase,
 stragglers, network flaps, poisoned payloads (corrupt / truncated / NaN /
-stale-replay), mid-round server kill-and-restart, and mesh-plane
-preemption / silent numerical corruption.
+stale-replay), mid-round server kill-and-restart, mesh-plane
+preemption / silent numerical corruption, and serving-plane faults
+(hot-swap installed mid-batch, device loss during a served batch —
+``ServeChaos`` for the round-10 serving plane's batcher).
 
 Split: :mod:`plan` is the pure, seeded fault schedule;
 :mod:`inject` adapts it to the transport client (``FedClient(chaos=...)``)
@@ -25,6 +27,7 @@ from fedcrack_tpu.chaos.inject import (
     InjectedDeviceFailure,
     InjectedRpcError,
     MeshChaos,
+    ServeChaos,
 )
 from fedcrack_tpu.chaos.plan import (
     ALL_KINDS,
@@ -38,6 +41,9 @@ from fedcrack_tpu.chaos.plan import (
     MESH_NONFINITE,
     NAN_UPDATE,
     NETWORK_FLAP,
+    SERVE_DEVICE_LOSS,
+    SERVE_KINDS,
+    SERVE_SWAP_MIDFLIGHT,
     STALE_REPLAY,
     STRAGGLER_DELAY,
     TRUNCATE_PAYLOAD,
@@ -64,7 +70,11 @@ __all__ = [
     "MeshChaos",
     "NAN_UPDATE",
     "NETWORK_FLAP",
+    "SERVE_DEVICE_LOSS",
+    "SERVE_KINDS",
+    "SERVE_SWAP_MIDFLIGHT",
     "STALE_REPLAY",
     "STRAGGLER_DELAY",
+    "ServeChaos",
     "TRUNCATE_PAYLOAD",
 ]

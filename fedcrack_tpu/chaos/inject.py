@@ -42,6 +42,8 @@ from fedcrack_tpu.chaos.plan import (
     MESH_NONFINITE,
     NAN_UPDATE,
     NETWORK_FLAP,
+    SERVE_DEVICE_LOSS,
+    SERVE_SWAP_MIDFLIGHT,
     STALE_REPLAY,
     STRAGGLER_DELAY,
     TRUNCATE_PAYLOAD,
@@ -180,6 +182,42 @@ class MeshChaos:
         if self.plan.take(MESH_NONFINITE, round=round_idx) is not None:
             return _nan_poison
         return None
+
+
+class ServeChaos:
+    """``chaos=`` hook for :class:`fedcrack_tpu.serve.batcher.MicroBatcher`.
+
+    Called as ``on_batch(bucket, batch_index, attempt)`` between the
+    worker's weights snapshot and the batch dispatch — exactly the window
+    where a hot swap or a device loss is most dangerous:
+
+    - ``SERVE_SWAP_MIDFLIGHT`` (matched on ``round == batch_index``) calls
+      ``swap_hook()`` (typically ``manager.poll_once``), installing a new
+      model AFTER the in-flight batch snapshotted its weights. The barrier
+      contract says the batch must still answer entirely from its snapshot
+      (no torn reads) — pinned by the chaos serving test.
+    - ``SERVE_DEVICE_LOSS`` raises :class:`InjectedDeviceFailure`; the
+      batcher retries the batch with a fresh snapshot and no request is
+      dropped. Faults fire only on ``attempt`` 0 so the retry runs clean
+      (the plan's one-shot semantics would guarantee that anyway; the guard
+      keeps a multi-fault plan from burning two faults on one batch).
+    """
+
+    def __init__(self, plan: FaultPlan, swap_hook=None):
+        self.plan = plan
+        self.swap_hook = swap_hook
+
+    def on_batch(self, bucket: int, batch_index: int, attempt: int) -> None:
+        if attempt > 0:
+            return
+        if self.plan.take(SERVE_SWAP_MIDFLIGHT, round=batch_index) is not None:
+            if self.swap_hook is not None:
+                self.swap_hook()
+        if self.plan.take(SERVE_DEVICE_LOSS, round=batch_index) is not None:
+            raise InjectedDeviceFailure(
+                f"injected serving device loss (bucket {bucket}, "
+                f"batch {batch_index}, attempt {attempt})"
+            )
 
 
 def _nan_poison(variables, metrics):

@@ -35,6 +35,11 @@ STALE_REPLAY = "stale_replay"                  # TrainDone re-tagged with round-
 MESH_DEVICE_FAIL = "mesh_device_fail"          # round dispatch raises (preemption)
 MESH_NONFINITE = "mesh_nonfinite"              # round output poisoned with NaNs
 
+# Serving plane (batcher hook; fedcrack_tpu.serve.batcher chaos=). `round`
+# is the 0-based batch index within the bucket worker.
+SERVE_SWAP_MIDFLIGHT = "serve_swap_midflight"  # install a new model while a batch is in flight
+SERVE_DEVICE_LOSS = "serve_device_loss"        # batch dispatch raises (device loss)
+
 CLIENT_KINDS = frozenset(
     {
         CRASH_BEFORE_UPLOAD,
@@ -49,7 +54,8 @@ CLIENT_KINDS = frozenset(
     }
 )
 MESH_KINDS = frozenset({MESH_DEVICE_FAIL, MESH_NONFINITE})
-ALL_KINDS = CLIENT_KINDS | MESH_KINDS
+SERVE_KINDS = frozenset({SERVE_SWAP_MIDFLIGHT, SERVE_DEVICE_LOSS})
+ALL_KINDS = CLIENT_KINDS | MESH_KINDS | SERVE_KINDS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -135,7 +141,8 @@ class FaultPlan:
         faults = []
         for _ in range(n_faults):
             kind = rng.choice(kind_pool)
-            if kind in MESH_KINDS:
+            if kind in MESH_KINDS or kind in SERVE_KINDS:
+                # Both planes use a 0-based index (driver round / batch).
                 faults.append(Fault(kind=kind, round=rng.randrange(n_rounds)))
             else:
                 faults.append(

@@ -100,6 +100,88 @@ class DataConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Serving-plane configuration (round 10): the TPU-native batched
+    inference endpoint that turns the federation's global model into a
+    served workload (ROADMAP north star: "serves heavy traffic").
+
+    The reference's inference path is a one-shot script
+    (test/Segmentation2.py); here prediction is a resident service with
+    pre-compiled per-bucket programs, dynamic micro-batching and live
+    hot-swap of the federated weights.
+    """
+
+    # Compiled square input buckets (H == W == size); a request lands in the
+    # smallest bucket that holds it (spatially zero-padded, output cropped),
+    # and anything larger than the largest bucket runs tiled sliding-window
+    # inference with the largest bucket as the tile.
+    bucket_sizes: tuple[int, ...] = (128, 256)
+    # Compiled batch per bucket: requests accumulate until max_batch or
+    # max_delay_ms, then are padded to exactly max_batch lanes (inference-
+    # mode BN is per-sample independent, so pad lanes cannot perturb real
+    # lanes — test-pinned).
+    max_batch: int = 8
+    max_delay_ms: float = 5.0
+    # Hot-swap poll period: how often the version manager checks the
+    # federation's checkpoint/statefile outputs for a newer global model.
+    swap_poll_s: float = 2.0
+    # Tile overlap (pixels) for sliding-window inference; overlapping rows/
+    # cols are blended with a deterministic separable ramp.
+    tile_overlap: int = 32
+    # Serving compute dtype (params stay float32, as in training).
+    compute_dtype: str = "float32"
+    # Data-parallel shard of a served batch over the mesh 'batch' axis;
+    # max_batch must be divisible by it.
+    mesh_batch: int = 1
+    # Default per-request deadline for accounting (0 = none). Requests past
+    # their deadline are still served (never dropped) but counted.
+    deadline_ms: float = 0.0
+    host: str = "127.0.0.1"
+    port: int = 8890
+    max_message_mb: int = 64
+
+    def __post_init__(self) -> None:
+        if not self.bucket_sizes:
+            raise ValueError("bucket_sizes must not be empty")
+        sizes = tuple(self.bucket_sizes)
+        if list(sizes) != sorted(set(sizes)):
+            raise ValueError(
+                f"bucket_sizes must be strictly increasing, got {sizes}"
+            )
+        for s in sizes:
+            if s <= 0 or s % 16 != 0:
+                raise ValueError(
+                    f"every bucket size must be a positive multiple of 16 "
+                    f"(the U-Net's spatial contract), got {s}"
+                )
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.max_delay_ms < 0:
+            raise ValueError(
+                f"max_delay_ms must be >= 0, got {self.max_delay_ms}"
+            )
+        if self.swap_poll_s <= 0:
+            raise ValueError(
+                f"swap_poll_s must be > 0, got {self.swap_poll_s}"
+            )
+        if self.tile_overlap < 0 or self.tile_overlap >= min(sizes):
+            raise ValueError(
+                f"tile_overlap must be in [0, smallest bucket), got "
+                f"{self.tile_overlap} with buckets {sizes}"
+            )
+        if self.mesh_batch < 1 or self.max_batch % self.mesh_batch != 0:
+            raise ValueError(
+                f"mesh_batch={self.mesh_batch} must be >= 1 and divide "
+                f"max_batch={self.max_batch}"
+            )
+        if self.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                "serve compute_dtype must be float32 or bfloat16, got "
+                f"{self.compute_dtype!r}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class FedConfig:
     """Federation round/protocol configuration.
 
@@ -224,6 +306,10 @@ class FedConfig:
     max_message_mb: int = 512     # reference: fl_server.py:215 (both directions here)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    # Serving plane (round 10): bucket/batching/hot-swap knobs for
+    # `python -m fedcrack_tpu.serve`. Rides the same config object so one
+    # preset describes a whole deployment (training + serving).
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     # Mesh shape for the TPU data plane: (#federated clients, per-client DP).
     mesh_clients: int = 8
     mesh_batch: int = 1
@@ -318,16 +404,23 @@ class FedConfig:
         raw = dict(raw)
         model = raw.pop("model", {})
         data = raw.pop("data", {})
+        serve = raw.pop("serve", {})
         known = {f.name for f in dataclasses.fields(cls)}
         raw = {k: v for k, v in raw.items() if k in known}
         mknown = {f.name for f in dataclasses.fields(ModelConfig)}
         dknown = {f.name for f in dataclasses.fields(DataConfig)}
+        sknown = {f.name for f in dataclasses.fields(ServeConfig)}
         mc = ModelConfig(**{k: _detuple(k, v) for k, v in model.items() if k in mknown})
         dc = DataConfig(**{k: v for k, v in data.items() if k in dknown})
-        return cls(model=mc, data=dc, **raw)
+        sc = ServeConfig(
+            **{k: _detuple(k, v) for k, v in serve.items() if k in sknown}
+        )
+        return cls(model=mc, data=dc, serve=sc, **raw)
 
 
 def _detuple(key: str, value: Any) -> Any:
-    if key in ("encoder_features", "decoder_features") and isinstance(value, list):
+    if key in ("encoder_features", "decoder_features", "bucket_sizes") and isinstance(
+        value, list
+    ):
         return tuple(value)
     return value

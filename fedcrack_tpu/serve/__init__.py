@@ -1,0 +1,33 @@
+"""Serving plane: TPU-native batched inference over the federated model.
+
+The subsystem that turns the training stack's outputs into the ROADMAP's
+"serves heavy traffic" story (round 10):
+
+- :mod:`fedcrack_tpu.serve.engine` — pre-compiled per-bucket predict
+  programs, spatial pad/crop routing, overlap-blended tiled sliding-window
+  inference for oversized images;
+- :mod:`fedcrack_tpu.serve.batcher` — dynamic micro-batching with
+  per-request deadline accounting and streaming latency percentiles;
+- :mod:`fedcrack_tpu.serve.hot_swap` — live model-version manager watching
+  the federation's checkpoint/statefile outputs, swapping served weights at
+  a request-boundary barrier (serve-while-training);
+- :mod:`fedcrack_tpu.serve.service` — the gRPC ``ServePlane/Predict``
+  front door (``python -m fedcrack_tpu.serve``).
+"""
+
+from fedcrack_tpu.serve.batcher import (  # noqa: F401
+    MicroBatcher,
+    PredictResult,
+    StaticWeights,
+)
+from fedcrack_tpu.serve.engine import InferenceEngine, tile_plan  # noqa: F401
+from fedcrack_tpu.serve.hot_swap import (  # noqa: F401
+    ModelVersionManager,
+    publish_statefile,
+    read_statefile_weights,
+)
+from fedcrack_tpu.serve.service import (  # noqa: F401
+    ServeServer,
+    ServeServerThread,
+    ServeService,
+)

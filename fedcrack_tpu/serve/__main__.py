@@ -1,0 +1,182 @@
+"""``python -m fedcrack_tpu.serve`` — boot the crack-segmentation endpoint.
+
+Builds the engine (one compiled program per bucket), resolves initial
+weights (``--weights`` msgpack > statefile > checkpoint dir > seed init, in
+that order), starts the hot-swap poller against the federation's
+checkpoint/statefile outputs, and serves ``fedcrack.ServePlane/Predict``
+until SIGTERM/SIGINT.
+
+Prints exactly one ``SERVING <host>:<port> ...`` line to stdout once ready —
+harnesses (tools/load_gen.py --spawn, the e2e smoke) key on it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import dataclasses
+import logging
+import signal
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m fedcrack_tpu.serve", description=__doc__
+    )
+    p.add_argument("--config", help="FedConfig JSON preset (serve + model sections)")
+    p.add_argument("--weights", help="msgpack pytree to serve initially")
+    p.add_argument("--ckpt-dir", help="orbax checkpoint dir to hot-swap from")
+    p.add_argument("--state-path", help="federation statefile to hot-swap from")
+    p.add_argument("--host")
+    p.add_argument("--port", type=int)
+    p.add_argument("--buckets", help="comma-separated bucket sizes, e.g. 128,256")
+    p.add_argument("--max-batch", type=int)
+    p.add_argument("--max-delay-ms", type=float)
+    p.add_argument("--tile-overlap", type=int,
+                   help="sliding-window overlap px (must be < smallest bucket)")
+    p.add_argument("--swap-poll-s", type=float)
+    p.add_argument("--compute-dtype", choices=["float32", "bfloat16"])
+    p.add_argument("--metrics-path", help="JSONL metrics sink (serve_batch/serve_swap)")
+    p.add_argument("--seed", type=int, default=0, help="init seed when no weights found")
+    return p
+
+
+def resolve_config(args):
+    from fedcrack_tpu.configs import FedConfig
+
+    if args.config:
+        with open(args.config) as f:
+            fed = FedConfig.from_json(f.read())
+    else:
+        fed = FedConfig()
+    serve = fed.serve
+    overrides = {}
+    if args.buckets:
+        overrides["bucket_sizes"] = tuple(
+            int(s) for s in args.buckets.split(",") if s.strip()
+        )
+    if args.max_batch is not None:
+        overrides["max_batch"] = args.max_batch
+    if args.max_delay_ms is not None:
+        overrides["max_delay_ms"] = args.max_delay_ms
+    if args.tile_overlap is not None:
+        overrides["tile_overlap"] = args.tile_overlap
+    if args.swap_poll_s is not None:
+        overrides["swap_poll_s"] = args.swap_poll_s
+    if args.compute_dtype:
+        overrides["compute_dtype"] = args.compute_dtype
+    if args.host:
+        overrides["host"] = args.host
+    if args.port is not None:
+        overrides["port"] = args.port
+    if overrides:
+        serve = dataclasses.replace(serve, **overrides)
+    return fed.model, serve
+
+
+def resolve_initial_weights(args, template, seed: int):
+    """(version, variables): explicit file > statefile > ckpt dir > seed."""
+    from fedcrack_tpu.serve.hot_swap import read_statefile_weights
+
+    if args.weights:
+        from fedcrack_tpu.fed.serialization import tree_from_bytes
+
+        with open(args.weights, "rb") as f:
+            return 0, tree_from_bytes(f.read(), template=template)
+    if args.state_path:
+        got = read_statefile_weights(args.state_path, template=template)
+        if got is not None:
+            return got
+    if args.ckpt_dir:
+        import os
+
+        from fedcrack_tpu.ckpt.manager import FedCheckpointer
+
+        if os.path.isdir(args.ckpt_dir):
+            with FedCheckpointer(args.ckpt_dir) as ckptr:
+                ckpt = ckptr.restore(template)
+            if ckpt is not None:
+                return ckpt.model_version, ckpt.variables
+    print(
+        "no weights source found; serving seed-initialized model "
+        f"(seed {seed}) until the first hot-swap",
+        file=sys.stderr,
+    )
+    return 0, template
+
+
+async def _serve(args) -> int:
+    import jax
+
+    from fedcrack_tpu.models.resunet import init_variables
+    from fedcrack_tpu.serve.batcher import MicroBatcher
+    from fedcrack_tpu.serve.engine import InferenceEngine
+    from fedcrack_tpu.serve.hot_swap import ModelVersionManager
+    from fedcrack_tpu.serve.service import ServeServer, ServeService
+
+    model_config, serve_config = resolve_config(args)
+    template = init_variables(jax.random.key(args.seed), model_config)
+    version, variables = resolve_initial_weights(args, template, args.seed)
+
+    metrics = None
+    if args.metrics_path:
+        from fedcrack_tpu.obs.metrics import MetricsLogger
+
+        metrics = MetricsLogger(args.metrics_path)
+
+    engine = InferenceEngine(model_config, serve_config)
+    manager = ModelVersionManager(
+        engine,
+        variables,
+        initial_version=version,
+        ckpt_dir=args.ckpt_dir,
+        state_path=args.state_path,
+        poll_s=serve_config.swap_poll_s,
+        template=template,
+        metrics=metrics,
+    )
+    engine.warmup(manager.snapshot()[1])
+    batcher = MicroBatcher(engine, manager, metrics=metrics)
+    server = ServeServer(
+        ServeService(engine, batcher, manager),
+        host=serve_config.host,
+        port=serve_config.port,
+        max_message_mb=serve_config.max_message_mb,
+    )
+    manager.start()
+    port = await server.start()
+    print(
+        f"SERVING {serve_config.host}:{port} "
+        f"buckets={','.join(str(s) for s in serve_config.bucket_sizes)} "
+        f"max_batch={serve_config.max_batch} version={manager.version}",
+        flush=True,
+    )
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except (NotImplementedError, RuntimeError):
+            pass
+    await stop.wait()
+    await server.stop()
+    manager.stop()
+    batcher.close()
+    if metrics is not None:
+        import json
+
+        print(json.dumps({"serve_stats": batcher.stats()}), flush=True)
+        metrics.close()
+    return 0
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    args = build_parser().parse_args(argv)
+    return asyncio.run(_serve(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

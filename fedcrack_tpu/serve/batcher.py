@@ -1,0 +1,312 @@
+"""Dynamic micro-batching for the serving plane.
+
+Requests for one bucket accumulate until ``max_batch`` lanes are waiting or
+``max_delay_ms`` has elapsed since the oldest queued request, then run as ONE
+compiled bucket program invocation (padded to the compiled batch). One worker
+thread per bucket keeps the device pipeline full without ever interleaving
+two batches of the same program.
+
+Weight-version semantics (the hot-swap contract, test-pinned in
+tests/test_serve.py): the worker takes ONE weights snapshot per batch — the
+**request-boundary barrier** — immediately before dispatch, and every request
+in that batch is answered from that snapshot. A swap installed while a batch
+is in flight affects only subsequent batches; no batch ever mixes versions
+(no torn reads), and no in-flight request is dropped.
+
+Accounting: per-request queue + total latency through a bounded
+:class:`fedcrack_tpu.obs.metrics.StreamingPercentiles` reservoir (p50/p95/p99),
+per-request deadline misses (requests past deadline are still served — the
+SLO counter is the signal, dropping is a policy this plane does not adopt),
+and the swap gap (idle time between the last pre-swap batch and the first
+post-swap batch). Optionally tees per-batch records into a
+``MetricsLogger``.
+
+Chaos: a :class:`fedcrack_tpu.chaos.inject.ServeChaos` hook runs between the
+snapshot and the dispatch of every batch. It may force a swap mid-flight
+(the snapshot already taken must win — exactly the torn-read scenario the
+barrier exists to prevent) or raise an injected device failure, which the
+worker retries with a fresh snapshot; requests survive both.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from fedcrack_tpu.obs.metrics import StreamingPercentiles
+
+# Bounded batch retries under injected/real device failures: a request is
+# only failed (never silently dropped) when every attempt raised.
+MAX_BATCH_ATTEMPTS = 3
+
+
+@dataclass
+class PredictResult:
+    """What the front door needs to answer one request."""
+
+    probs: np.ndarray          # [S, S, 1] float32 bucket-resolution output
+    model_version: int
+    queue_ms: float
+    latency_ms: float
+    deadline_missed: bool
+
+
+@dataclass
+class _Request:
+    image: np.ndarray          # [S, S, 3] uint8, already bucket-shaped
+    t_submit: float
+    deadline_s: float | None   # absolute monotonic deadline, None = none
+    future: Future = field(default_factory=Future)
+
+
+class StaticWeights:
+    """Minimal weights source for swap-less serving and tests: a constant
+    (version, variables) snapshot matching the hot-swap manager's API."""
+
+    def __init__(self, variables: Any, version: int = 0):
+        self._snap = (version, variables)
+
+    def snapshot(self) -> tuple[int, Any]:
+        return self._snap
+
+
+class MicroBatcher:
+    """Per-bucket micro-batching over one :class:`InferenceEngine`.
+
+    ``weights`` is any object with ``snapshot() -> (version, variables)`` —
+    :class:`StaticWeights` or the hot-swap ``ModelVersionManager``.
+    """
+
+    def __init__(
+        self,
+        engine: Any,
+        weights: Any,
+        *,
+        max_delay_ms: float | None = None,
+        metrics: Any | None = None,
+        chaos: Any | None = None,
+        reservoir_capacity: int = 4096,
+    ):
+        self.engine = engine
+        self.weights = weights
+        self.max_batch = engine.max_batch
+        cfg_delay = engine.serve_config.max_delay_ms
+        self.max_delay_s = (
+            cfg_delay if max_delay_ms is None else max_delay_ms
+        ) / 1e3
+        self._metrics = metrics
+        self._chaos = chaos
+        self._queues: dict[int, queue.Queue] = {
+            size: queue.Queue() for size in engine.bucket_sizes
+        }
+        self.latency = StreamingPercentiles(reservoir_capacity)
+        self.queue_latency = StreamingPercentiles(reservoir_capacity)
+        self._lock = threading.Lock()
+        self._counts = {
+            "submitted": 0,
+            "completed": 0,
+            "failed": 0,
+            "deadline_missed": 0,
+            "batches": 0,
+            "batch_retries": 0,
+        }
+        self._per_bucket: dict[int, int] = {s: 0 for s in engine.bucket_sizes}
+        self._versions_served: dict[int, int] = {}
+        self._last_batch_end: float | None = None
+        self._last_version: int | None = None
+        self.swap_gaps_ms: list[float] = []
+        self._running = True
+        self._workers = [
+            threading.Thread(target=self._worker, args=(size,), daemon=True)
+            for size in engine.bucket_sizes
+        ]
+        for t in self._workers:
+            t.start()
+
+    # ---- submission ----
+
+    def submit(self, image_u8: np.ndarray, deadline_ms: float | None = None) -> Future:
+        """Enqueue one bucket-shaped [S, S, 3] uint8 image; resolves to a
+        :class:`PredictResult`. Raises immediately on a non-bucket shape."""
+        h, w, _ = image_u8.shape
+        if h != w or h not in self._queues:
+            raise ValueError(
+                f"submit() takes exact bucket shapes {self.engine.bucket_sizes}; "
+                f"got {image_u8.shape} (route through the front door for "
+                f"padding/tiling)"
+            )
+        if not self._running:
+            raise RuntimeError("batcher is closed")
+        now = time.monotonic()
+        cfg_deadline = self.engine.serve_config.deadline_ms
+        if deadline_ms is None and cfg_deadline > 0:
+            deadline_ms = cfg_deadline
+        req = _Request(
+            image=image_u8,
+            t_submit=now,
+            deadline_s=(now + deadline_ms / 1e3) if deadline_ms else None,
+        )
+        with self._lock:
+            self._counts["submitted"] += 1
+        self._queues[h].put(req)
+        return req.future
+
+    # ---- the per-bucket worker ----
+
+    def _collect(self, size: int) -> list[_Request] | None:
+        """Block for the first request, then fill until max_batch or the
+        delay window closes. None = shutdown."""
+        q = self._queues[size]
+        while True:
+            try:
+                first = q.get(timeout=0.05)
+                break
+            except queue.Empty:
+                if not self._running:
+                    return None
+        batch = [first]
+        t_close = time.monotonic() + self.max_delay_s
+        while len(batch) < self.max_batch:
+            remaining = t_close - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                batch.append(q.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return batch
+
+    def _worker(self, size: int) -> None:
+        batch_index = 0
+        while True:
+            batch = self._collect(size)
+            if batch is None:
+                return
+            self._execute(size, batch, batch_index)
+            batch_index += 1
+
+    def _execute(self, size: int, batch: list[_Request], batch_index: int) -> None:
+        images = np.stack([r.image for r in batch])
+        last_err: Exception | None = None
+        for attempt in range(MAX_BATCH_ATTEMPTS):
+            # Request-boundary barrier: one snapshot per ATTEMPT, taken
+            # immediately before dispatch. Everything this batch returns
+            # comes from this snapshot, whatever installs meanwhile.
+            version, variables = self.weights.snapshot()
+            if self._chaos is not None:
+                try:
+                    self._chaos.on_batch(size, batch_index, attempt)
+                except Exception as e:  # injected device loss -> retry
+                    last_err = e
+                    with self._lock:
+                        self._counts["batch_retries"] += 1
+                    continue
+            try:
+                t0 = time.monotonic()
+                probs = self.engine.predict_bucket(variables, images)
+                t1 = time.monotonic()
+            except Exception as e:
+                last_err = e
+                with self._lock:
+                    self._counts["batch_retries"] += 1
+                continue
+            self._resolve(batch, probs, version, t0, t1, size)
+            return
+        # Every attempt failed: requests error out loudly, never hang.
+        with self._lock:
+            self._counts["failed"] += len(batch)
+        for r in batch:
+            r.future.set_exception(
+                last_err if last_err is not None else RuntimeError("batch failed")
+            )
+
+    def _resolve(self, batch, probs, version, t0, t1, size) -> None:
+        with self._lock:
+            self._counts["completed"] += len(batch)
+            self._counts["batches"] += 1
+            self._per_bucket[size] += len(batch)
+            self._versions_served[version] = (
+                self._versions_served.get(version, 0) + len(batch)
+            )
+            if self._last_version is not None and version != self._last_version:
+                # Swap pause as the served plane sees it: idle gap between
+                # the previous batch's completion and this (first post-swap)
+                # batch's dispatch. Clamped at 0 — concurrent bucket workers
+                # can legitimately overlap across the version boundary.
+                gap = (t0 - self._last_batch_end) * 1e3 if self._last_batch_end else 0.0
+                self.swap_gaps_ms.append(max(0.0, gap))
+            self._last_version = version
+            self._last_batch_end = t1
+        n_missed = 0
+        for i, r in enumerate(batch):
+            queue_ms = (t0 - r.t_submit) * 1e3
+            latency_ms = (t1 - r.t_submit) * 1e3
+            missed = r.deadline_s is not None and t1 > r.deadline_s
+            n_missed += bool(missed)
+            self.queue_latency.add(queue_ms)
+            self.latency.add(latency_ms)
+            r.future.set_result(
+                PredictResult(
+                    probs=probs[i],
+                    model_version=version,
+                    queue_ms=queue_ms,
+                    latency_ms=latency_ms,
+                    deadline_missed=missed,
+                )
+            )
+        if n_missed:
+            with self._lock:
+                self._counts["deadline_missed"] += n_missed
+        if self._metrics is not None:
+            self._metrics.log(
+                "serve_batch",
+                bucket=size,
+                batch=len(batch),
+                model_version=version,
+                exec_ms=round((t1 - t0) * 1e3, 3),
+            )
+
+    # ---- observability / shutdown ----
+
+    def stats(self) -> dict:
+        """One JSON-safe snapshot: counters, per-bucket traffic, versions
+        served, latency percentiles, swap gaps."""
+        with self._lock:
+            counts = dict(self._counts)
+            per_bucket = {str(k): v for k, v in self._per_bucket.items()}
+            versions = {str(k): v for k, v in self._versions_served.items()}
+            gaps = list(self.swap_gaps_ms)
+        return {
+            **counts,
+            "per_bucket": per_bucket,
+            "versions_served": versions,
+            "swap_gaps_ms": [round(g, 3) for g in gaps],
+            "latency_ms": self.latency.summary(),
+            "queue_ms": self.queue_latency.summary(),
+        }
+
+    def close(self) -> None:
+        """Stop accepting work, let workers drain, fail anything left."""
+        self._running = False
+        for t in self._workers:
+            t.join(timeout=10)
+        for q in self._queues.values():
+            while True:
+                try:
+                    r = q.get_nowait()
+                except queue.Empty:
+                    break
+                if not r.future.done():
+                    r.future.set_exception(RuntimeError("batcher closed"))
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

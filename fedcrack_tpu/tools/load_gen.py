@@ -1,0 +1,436 @@
+"""Closed/open-loop load generator for the serving plane.
+
+Drives ``fedcrack.ServePlane/Predict`` (serve/service.py) with synthetic
+crack images and reports a machine-readable summary: completed/dropped
+counts, client-side latency percentiles (p50/p95/p99 via the same bounded
+reservoir the server uses), throughput, per-bucket traffic, and the set of
+model versions observed — the last is how a harness proves a live hot-swap
+actually landed mid-run.
+
+Modes:
+
+- **closed** (default): ``concurrency`` workers, each with its own stream,
+  one request in flight per worker — latency under a fixed multiprogramming
+  level (the classic closed-loop SLO probe).
+- **open**: one stream, requests injected at a fixed ``rate_rps`` regardless
+  of completions (sender/receiver threads) — the overload-behavior probe; a
+  server that falls behind shows it as growing latency, never as drops.
+
+``--swap-statefile``/``--swap-after`` publish new weights (a bumped
+``model_version`` statefile, ``serve.hot_swap.publish_statefile``) after the
+N-th completion — a one-command serve-while-training smoke against a server
+watching that path.
+
+Masks can be dumped as PNGs (``--out-dir``) and piped straight into
+``tools/quantify.py --pred-dir`` — the reference's contour quantification
+over served output.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from queue import Empty, Queue
+from typing import Any, Sequence
+
+import numpy as np
+
+from fedcrack_tpu.obs.metrics import StreamingPercentiles
+from fedcrack_tpu.transport import transport_pb2 as pb
+from fedcrack_tpu.transport.service import channel_options
+from fedcrack_tpu.serve.service import OK, PREDICT_PATH
+
+_STOP = object()
+
+
+def make_images(
+    n: int, sizes: Sequence[int], seed: int = 0
+) -> list[np.ndarray]:
+    """n uint8 RGB crack images cycling through ``sizes`` — request i gets
+    size ``sizes[i % len(sizes)]``, so any n >= 2*len(sizes) exercises every
+    bucket."""
+    from fedcrack_tpu.data.pipeline import to_uint8_transport
+    from fedcrack_tpu.data.synthetic import synth_crack_batch
+
+    per_size: dict[int, list[np.ndarray]] = {}
+    for si, size in enumerate(sizes):
+        count = len(range(si, n, len(sizes)))
+        if not count:
+            continue
+        imgs_f, msks_f = synth_crack_batch(count, img_size=size, seed=seed + si)
+        imgs_u8, _ = to_uint8_transport(imgs_f, msks_f)
+        per_size[size] = list(imgs_u8)
+    out = []
+    for i in range(n):
+        size = sizes[i % len(sizes)]
+        out.append(per_size[size].pop())
+    return out
+
+
+def _request_chunks(
+    request_id: int,
+    image: np.ndarray,
+    *,
+    threshold: float,
+    deadline_ms: float,
+    chunk_bytes: int,
+    crc: bool,
+):
+    """LogChunk-style framing of one image (offset/last + optional CRC32C)."""
+    h, w, c = image.shape
+    blob = image.tobytes()
+    n = max(1, chunk_bytes)
+    for off in range(0, len(blob), n):
+        piece = blob[off : off + n]
+        msg = pb.PredictRequest(
+            client_id="load_gen",
+            request_id=request_id,
+            height=h,
+            width=w,
+            channels=c,
+            image=piece,
+            offset=off,
+            last=off + n >= len(blob),
+            threshold=threshold,
+            deadline_ms=deadline_ms,
+        )
+        if crc:
+            from fedcrack_tpu.native import crc32c
+
+            msg.crc32c = crc32c(piece)
+        yield msg
+
+
+class _Collector:
+    """Thread-safe result aggregation shared by all workers."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latency = StreamingPercentiles(8192)
+        self.completed = 0
+        self.rejected = 0
+        self.deadline_missed = 0
+        self.per_size: dict[str, int] = {}
+        self.versions: dict[str, int] = {}
+        self.server_latency = StreamingPercentiles(8192)
+        self.masks: list[tuple[int, int, int, bytes]] = []
+
+    def record(self, resp: pb.PredictResponse, latency_s: float, keep_mask: bool):
+        with self.lock:
+            if resp.status != OK:
+                self.rejected += 1
+                return
+            self.completed += 1
+            self.latency.add(latency_s * 1e3)
+            self.server_latency.add(resp.latency_ms)
+            key = f"{resp.height}x{resp.width}"
+            self.per_size[key] = self.per_size.get(key, 0) + 1
+            v = str(resp.model_version)
+            self.versions[v] = self.versions.get(v, 0) + 1
+            if keep_mask:
+                self.masks.append(
+                    (int(resp.request_id), resp.height, resp.width, resp.mask)
+                )
+
+
+def _stream_call(channel):
+    return channel.stream_stream(
+        PREDICT_PATH,
+        request_serializer=pb.PredictRequest.SerializeToString,
+        response_deserializer=pb.PredictResponse.FromString,
+    )
+
+
+def _closed_worker(
+    stub, jobs: Queue, collector: _Collector, opts: dict, on_complete
+) -> None:
+    """One worker = one stream, one request in flight at a time."""
+
+    send_q: Queue = Queue()
+
+    def request_iter():
+        while True:
+            item = send_q.get()
+            if item is _STOP:
+                return
+            yield from item
+
+    responses = stub(request_iter())
+    try:
+        while True:
+            try:
+                request_id, image = jobs.get_nowait()
+            except Empty:
+                break
+            t0 = time.perf_counter()
+            send_q.put(
+                list(
+                    _request_chunks(
+                        request_id,
+                        image,
+                        threshold=opts["threshold"],
+                        deadline_ms=opts["deadline_ms"],
+                        chunk_bytes=opts["chunk_bytes"],
+                        crc=opts["crc"],
+                    )
+                )
+            )
+            try:
+                resp = next(responses)
+            except StopIteration:
+                break  # server ended the stream; remaining jobs count as dropped
+            collector.record(resp, time.perf_counter() - t0, opts["keep_masks"])
+            if on_complete is not None:
+                on_complete()
+    finally:
+        send_q.put(_STOP)
+
+
+def _open_loop(
+    stub, images: list, collector: _Collector, opts: dict, rate_rps: float, on_complete
+) -> None:
+    """One stream; a sender injects at the target rate, a receiver drains."""
+    send_q: Queue = Queue()
+    t_sent: dict[int, float] = {}
+    lock = threading.Lock()
+
+    def request_iter():
+        while True:
+            item = send_q.get()
+            if item is _STOP:
+                return
+            yield from item
+
+    responses = stub(request_iter())
+
+    def receiver():
+        for _ in range(len(images)):
+            try:
+                resp = next(responses)
+            except StopIteration:
+                return
+            with lock:
+                t0 = t_sent.pop(int(resp.request_id), None)
+            lat = (time.perf_counter() - t0) if t0 is not None else 0.0
+            collector.record(resp, lat, opts["keep_masks"])
+            if on_complete is not None:
+                on_complete()
+
+    rx = threading.Thread(target=receiver, daemon=True)
+    rx.start()
+    period = 1.0 / max(rate_rps, 1e-6)
+    t_next = time.perf_counter()
+    for rid, image in enumerate(images):
+        now = time.perf_counter()
+        if now < t_next:
+            time.sleep(t_next - now)
+        t_next += period
+        with lock:
+            t_sent[rid] = time.perf_counter()
+        send_q.put(
+            list(
+                _request_chunks(
+                    rid,
+                    image,
+                    threshold=opts["threshold"],
+                    deadline_ms=opts["deadline_ms"],
+                    chunk_bytes=opts["chunk_bytes"],
+                    crc=opts["crc"],
+                )
+            )
+        )
+    rx.join(timeout=opts["timeout_s"])
+    send_q.put(_STOP)
+
+
+def run_load(
+    target: str,
+    *,
+    mode: str = "closed",
+    n_requests: int = 64,
+    concurrency: int = 4,
+    rate_rps: float = 50.0,
+    sizes: Sequence[int] = (128,),
+    seed: int = 0,
+    threshold: float = 0.5,
+    deadline_ms: float = 0.0,
+    chunk_bytes: int = 1 << 20,
+    crc: bool = True,
+    timeout_s: float = 300.0,
+    keep_masks: bool = False,
+    max_message_mb: int = 64,
+    on_complete=None,
+) -> dict:
+    """Drive the endpoint; returns the JSON-safe summary (see module doc).
+    ``on_complete()`` fires after every completed request — harnesses hook
+    swap triggers on it."""
+    import grpc
+
+    if mode not in ("closed", "open"):
+        raise ValueError(f"mode must be 'closed' or 'open', got {mode!r}")
+    images = make_images(n_requests, sizes, seed)
+    collector = _Collector()
+    opts = {
+        "threshold": threshold,
+        "deadline_ms": deadline_ms,
+        "chunk_bytes": chunk_bytes,
+        "crc": crc,
+        "timeout_s": timeout_s,
+        "keep_masks": keep_masks,
+    }
+    channel = grpc.insecure_channel(target, options=channel_options(max_message_mb))
+    t_start = time.perf_counter()
+    try:
+        grpc.channel_ready_future(channel).result(timeout=30)
+        stub = _stream_call(channel)
+        if mode == "closed":
+            jobs: Queue = Queue()
+            for rid, image in enumerate(images):
+                jobs.put((rid, image))
+            workers = [
+                threading.Thread(
+                    target=_closed_worker,
+                    args=(stub, jobs, collector, opts, on_complete),
+                    daemon=True,
+                )
+                for _ in range(max(1, concurrency))
+            ]
+            for w in workers:
+                w.start()
+            deadline = time.monotonic() + timeout_s
+            for w in workers:
+                w.join(timeout=max(0.0, deadline - time.monotonic()))
+        else:
+            _open_loop(stub, images, collector, opts, rate_rps, on_complete)
+    finally:
+        channel.close()
+    wall_s = time.perf_counter() - t_start
+
+    with collector.lock:
+        completed = collector.completed
+        rejected = collector.rejected
+        per_size = dict(collector.per_size)
+        versions = dict(collector.versions)
+    return {
+        "mode": mode,
+        "target": target,
+        "n_requests": n_requests,
+        "completed": completed,
+        "rejected": rejected,
+        "dropped": n_requests - completed - rejected,
+        "wall_s": round(wall_s, 3),
+        "throughput_rps": round(completed / wall_s, 3) if wall_s > 0 else None,
+        "concurrency": concurrency if mode == "closed" else None,
+        "rate_rps": rate_rps if mode == "open" else None,
+        "sizes": list(sizes),
+        "per_size": per_size,
+        "versions_observed": versions,
+        "latency_ms": collector.latency.summary(),
+        "server_latency_ms": collector.server_latency.summary(),
+        "masks": collector.masks if keep_masks else None,
+    }
+
+
+def write_masks(masks, out_dir: str) -> int:
+    """Dump (request_id, h, w, bytes) masks as PNGs for tools/quantify.py
+    --pred-dir; returns how many were written."""
+    import os
+
+    import cv2
+
+    os.makedirs(out_dir, exist_ok=True)
+    n = 0
+    for rid, h, w, blob in masks:
+        mask = np.frombuffer(blob, np.uint8).reshape(h, w)
+        cv2.imwrite(os.path.join(out_dir, f"mask_{rid:05d}.png"), mask)
+        n += 1
+    return n
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from fedcrack_tpu.serve.hot_swap import publish_statefile
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--target", default="127.0.0.1:8890", help="host:port")
+    p.add_argument("--mode", choices=["closed", "open"], default="closed")
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--concurrency", type=int, default=4)
+    p.add_argument("--rate-rps", type=float, default=50.0)
+    p.add_argument("--sizes", default="128", help="comma-separated request sizes")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--threshold", type=float, default=0.5)
+    p.add_argument("--deadline-ms", type=float, default=0.0)
+    p.add_argument("--timeout-s", type=float, default=300.0)
+    p.add_argument("--out-dir", help="write served masks as PNGs here")
+    p.add_argument(
+        "--swap-statefile",
+        help="publish new weights to this statefile mid-run (live hot-swap smoke)",
+    )
+    p.add_argument("--swap-after", type=int, default=0,
+                   help="publish the swap after N completed requests")
+    p.add_argument("--swap-version", type=int, default=1000)
+    p.add_argument("--swap-seed", type=int, default=1)
+    p.add_argument("--img-size", type=int, default=128,
+                   help="model config size for --swap-statefile weights init")
+    args = p.parse_args(argv)
+
+    sizes = tuple(int(s) for s in args.sizes.split(",") if s.strip())
+
+    swap_state = {"fired": False, "count": 0}
+    swap_blob = None
+    if args.swap_statefile:
+        # Encode the swap weights BEFORE the run: serializing a full model
+        # at trigger time costs seconds under load and would push the
+        # publish past the end of the run.
+        import jax
+
+        from fedcrack_tpu.configs import ModelConfig
+        from fedcrack_tpu.fed.serialization import tree_to_bytes
+        from fedcrack_tpu.models.resunet import init_variables
+
+        swap_blob = tree_to_bytes(
+            init_variables(
+                jax.random.key(args.swap_seed), ModelConfig(img_size=args.img_size)
+            )
+        )
+
+    def on_complete():
+        swap_state["count"] += 1
+        if (
+            not swap_state["fired"]
+            and swap_state["count"] >= args.swap_after > 0
+        ):
+            swap_state["fired"] = True
+            publish_statefile(
+                args.swap_statefile, model_version=args.swap_version, blob=swap_blob
+            )
+
+    summary = run_load(
+        args.target,
+        mode=args.mode,
+        n_requests=args.requests,
+        concurrency=args.concurrency,
+        rate_rps=args.rate_rps,
+        sizes=sizes,
+        seed=args.seed,
+        threshold=args.threshold,
+        deadline_ms=args.deadline_ms,
+        timeout_s=args.timeout_s,
+        keep_masks=bool(args.out_dir),
+        on_complete=on_complete if args.swap_statefile else None,
+    )
+    masks = summary.pop("masks", None)
+    if args.out_dir and masks:
+        summary["masks_written"] = write_masks(masks, args.out_dir)
+    summary["swap_published"] = swap_state["fired"] if args.swap_statefile else None
+    print(json.dumps(summary), flush=True)
+    return 0 if summary["dropped"] == 0 else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -145,21 +145,66 @@ def predict_and_quantify(
     return reports
 
 
+def _stats_record(name, s: CrackStats) -> dict:
+    return {
+        "image": name,
+        "contours": s.contour_count,
+        "area_px": s.total_area_px,
+        "perimeter_px": s.total_perimeter_px,
+        "crack_fraction": s.crack_fraction,
+    }
+
+
+MASK_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".tif", ".tiff")
+
+
+def quantify_mask_dir(pred_dir: str, threshold: int = 127) -> dict:
+    """Batch-directory mode (round 10): quantify every predicted-mask image
+    in ``pred_dir`` (sorted, so output order is stable) WITHOUT a model —
+    the serving plane's post-processing step pipes its returned masks (e.g.
+    ``tools/load_gen.py --out-dir``) straight through this. Returns
+    ``{"images": [per-image stats...], "totals": {...}}``."""
+    import cv2
+
+    if not os.path.isdir(pred_dir):
+        raise ValueError(f"--pred-dir {pred_dir} is not a directory")
+    names = sorted(
+        n
+        for n in os.listdir(pred_dir)
+        if n.lower().endswith(MASK_EXTENSIONS)
+    )
+    if not names:
+        raise ValueError(f"no mask images ({'/'.join(MASK_EXTENSIONS)}) in {pred_dir}")
+    images = []
+    totals = {"contours": 0, "area_px": 0.0, "perimeter_px": 0.0}
+    for name in names:
+        mask = cv2.imread(os.path.join(pred_dir, name), cv2.IMREAD_GRAYSCALE)
+        if mask is None:
+            raise ValueError(f"unreadable mask image: {name}")
+        s = quantify_mask(mask, threshold=threshold)
+        images.append(_stats_record(name, s))
+        totals["contours"] += s.contour_count
+        totals["area_px"] += s.total_area_px
+        totals["perimeter_px"] += s.total_perimeter_px
+    totals["images"] = len(images)
+    totals["mean_crack_fraction"] = float(
+        np.mean([r["crack_fraction"] for r in images])
+    )
+    return {"images": images, "totals": totals}
+
+
 def main(argv=None) -> None:
     """``python -m fedcrack_tpu.tools.quantify`` — the reference's inference +
     crack-quantification script (test/Segmentation2.py) as a real CLI: load
-    trained weights, predict masks, write overlays, print per-image stats."""
+    trained weights, predict masks, write overlays, print per-image stats.
+    ``--pred-dir`` skips the model entirely and quantifies a directory of
+    already-predicted masks (the serving plane's output); ``--out-json``
+    writes the machine-readable stats in either mode."""
     import argparse
     import json
 
-    import jax
-
-    from fedcrack_tpu.configs import ModelConfig
-    from fedcrack_tpu.fed.serialization import tree_from_bytes
-    from fedcrack_tpu.train.local import create_train_state
-
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--weights", required=True, help="msgpack pytree (best.msgpack)")
+    p.add_argument("--weights", help="msgpack pytree (best.msgpack)")
     p.add_argument("--image-dir")
     p.add_argument("--mask-dir")
     p.add_argument("--synthetic", type=int, default=0, help="use N generated samples")
@@ -168,7 +213,39 @@ def main(argv=None) -> None:
     p.add_argument("--out-dir", default="contour")  # reference wrote contour/imgN.jpg
     p.add_argument("--max-images", type=int, default=8)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--pred-dir",
+        help="batch mode: quantify every predicted-mask image in this "
+        "directory (no model/weights needed)",
+    )
+    p.add_argument(
+        "--mask-threshold", type=int, default=127,
+        help="binarization threshold on the 0..255 scale (reference: >127)",
+    )
+    p.add_argument("--out-json", help="write machine-readable stats JSON here")
     args = p.parse_args(argv)
+
+    if args.pred_dir:
+        try:
+            report = quantify_mask_dir(args.pred_dir, threshold=args.mask_threshold)
+        except ValueError as e:
+            p.error(str(e))
+        for r in report["images"]:
+            print(json.dumps(r))
+        print(json.dumps({"totals": report["totals"]}))
+        if args.out_json:
+            with open(args.out_json, "w") as f:
+                json.dump(report, f, indent=2, sort_keys=True)
+        return
+
+    if not args.weights:
+        p.error("--weights is required unless --pred-dir is given")
+
+    import jax
+
+    from fedcrack_tpu.configs import ModelConfig
+    from fedcrack_tpu.fed.serialization import tree_from_bytes
+    from fedcrack_tpu.train.local import create_train_state
 
     model_config = ModelConfig(img_size=args.img_size)
     state = create_train_state(jax.random.key(args.seed), model_config)
@@ -198,6 +275,9 @@ def main(argv=None) -> None:
     )
     for r in reports:
         print(json.dumps(r))
+    if args.out_json:
+        with open(args.out_json, "w") as f:
+            json.dump({"images": reports}, f, indent=2, sort_keys=True)
 
 
 if __name__ == "__main__":

@@ -185,8 +185,13 @@ def test_detail_schema_declares_contract_keys():
         "layout_ab",
         "segmented_pipeline",
         "resident_pool",
+        "serving",
     }
     assert required <= set(bench.DETAIL_SCHEMA)
+    # Round-10 serving arm: the SLO keys BASELINE.md reads must be declared.
+    assert {"throughput_rps", "latency_ms", "swap", "dropped"} <= set(
+        bench.SERVING_SCHEMA
+    )
     assert {"round_ms", "round_plus_restage_ms", "staging_hidden_frac"} <= set(
         bench.REF_POINT_SCHEMA
     )
@@ -194,7 +199,7 @@ def test_detail_schema_declares_contract_keys():
     # declared key must appear as a literal in bench.py's emitting code.
     with open(bench.__file__) as f:
         src = f.read()
-    for key in required | set(bench.REF_POINT_SCHEMA):
+    for key in required | set(bench.REF_POINT_SCHEMA) | set(bench.SERVING_SCHEMA):
         assert f'"{key}"' in src, f"schema key {key!r} never written by bench.py"
 
 
@@ -223,9 +228,25 @@ def test_validate_detail_typed_checks():
                 "resident": {"round_ms": 7420.0, "round_plus_restage_ms": 7500.0},
             }
         },
+        "serving": {
+            "throughput_rps": 41.5,
+            "latency_ms": {"p50": 120.0, "p95": 180.0, "p99": 220.0},
+            "requests": {"total": 128, "completed": 128},
+            "batcher": {"batches": 20},
+            "swap": {"to_version": 1, "load_ms": 35.0, "gap_ms": 4.0},
+            "dropped": 0,
+        },
     }
     assert bench.validate_detail(good) == []
     assert bench.validate_detail({}) == []  # every section is optional
+    # A serving section that errored out is exempt from the typed contract…
+    assert bench.validate_detail({"serving": {"error": "boom"}}) == []
+    # …but a present one must carry every declared key with the right type.
+    assert any(
+        "serving" in v for v in bench.validate_detail({"serving": {"dropped": 0}})
+    )
+    bad_serving = dict(good, serving=dict(good["serving"], dropped="none"))
+    assert any("serving['dropped']" in v for v in bench.validate_detail(bad_serving))
     bad = dict(good, skipped="oops")
     assert any("skipped" in v for v in bench.validate_detail(bad))
     bad2 = dict(

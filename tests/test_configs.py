@@ -12,7 +12,7 @@ import os
 
 import pytest
 
-from fedcrack_tpu.configs import DataConfig, FedConfig, ModelConfig
+from fedcrack_tpu.configs import DataConfig, FedConfig, ModelConfig, ServeConfig
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -44,6 +44,14 @@ def test_json_round_trip_preserves_everything():
             res_layout="packed",
         ),
         data=DataConfig(img_size=256, batch_size=32, partition="skew"),
+        serve=ServeConfig(
+            bucket_sizes=(128, 256, 512),
+            max_batch=16,
+            max_delay_ms=3.0,
+            swap_poll_s=0.5,
+            compute_dtype="bfloat16",
+            deadline_ms=100.0,
+        ),
     )
     assert FedConfig.from_json(cfg.to_json()) == cfg
 
@@ -69,6 +77,21 @@ def test_invalid_configs_rejected_at_construction():
         FedConfig(wire_dtype="float16")
     with pytest.raises(ValueError, match="must match"):
         FedConfig(model=ModelConfig(img_size=256), data=DataConfig(img_size=128))
+
+
+def test_serve_section_loads_with_defaults_and_survives_round_trip():
+    """Presets written before round 10 carry no "serve" key — they must load
+    with defaults; bucket_sizes must come back from JSON as a tuple (it is
+    compared against mesh shapes and used as dict keys downstream)."""
+    old = json.loads(FedConfig().to_json())
+    old.pop("serve", None)
+    cfg = FedConfig.from_dict(old)
+    assert cfg.serve == ServeConfig()
+    back = FedConfig.from_json(cfg.to_json())
+    assert isinstance(back.serve.bucket_sizes, tuple)
+    assert back.serve == cfg.serve
+    with pytest.raises(ValueError, match="bucket size"):
+        ServeConfig(bucket_sizes=(100,))
 
 
 def test_encoder_features_survive_json_as_tuples():

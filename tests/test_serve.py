@@ -1,0 +1,685 @@
+"""Serving plane (round 10): engine buckets, micro-batching, hot swap, chaos,
+and the gRPC front door.
+
+The load-bearing claims, each pinned here:
+
+- bucket programs are exact at bucket shapes and pad lanes cannot perturb
+  real lanes (inference-mode BN is per-sample independent);
+- tiled sliding-window inference is byte-deterministic and degenerates to
+  the plain bucket program for a single-tile image;
+- the batcher's request-boundary barrier means a batch straddling a weight
+  swap answers ENTIRELY from one version (no torn reads), and post-swap
+  outputs are BIT-identical to a cold start of the same weights;
+- injected serving faults (swap mid-flight, device loss mid-batch) drop
+  zero requests;
+- the hand-regenerated transport_pb2 serving descriptors cannot drift from
+  transport.proto (the regen script's DescriptorProtos are compared against
+  both the live module and the .proto text).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.serve
+
+TINY_KW = dict(
+    img_size=32, stem_features=4, encoder_features=(8,), decoder_features=(8, 4)
+)
+BUCKETS = (16, 32)
+
+
+@pytest.fixture(scope="module")
+def stack():
+    """One compiled engine + two weight versions shared by the module (the
+    bucket compiles dominate test cost; every test takes fresh batchers /
+    managers over the same engine)."""
+    import jax
+
+    from fedcrack_tpu.configs import ModelConfig, ServeConfig
+    from fedcrack_tpu.models.resunet import init_variables
+    from fedcrack_tpu.serve import InferenceEngine
+
+    model_config = ModelConfig(**TINY_KW)
+    serve_config = ServeConfig(
+        bucket_sizes=BUCKETS, max_batch=4, max_delay_ms=10.0, tile_overlap=4
+    )
+    engine = InferenceEngine(model_config, serve_config)
+    var0 = init_variables(jax.random.key(0), model_config)
+    var1 = init_variables(jax.random.key(1), model_config)
+    return engine, var0, var1
+
+
+def _images(n, size, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, (n, size, size, 3), dtype=np.uint8)
+
+
+# ---- streaming percentiles (obs satellite) ----
+
+
+def test_streaming_percentiles_exact_until_capacity():
+    from fedcrack_tpu.obs.metrics import StreamingPercentiles
+
+    rng = np.random.default_rng(7)
+    samples = rng.exponential(20.0, size=1000)
+    sp = StreamingPercentiles(capacity=2048)
+    for v in samples:
+        sp.add(v)
+    # Under capacity the reservoir holds everything: EXACTLY numpy.
+    for q in (0.0, 50.0, 95.0, 99.0, 100.0):
+        assert sp.percentile(q) == pytest.approx(
+            float(np.percentile(samples, q)), rel=1e-12
+        )
+    s = sp.summary()
+    assert s["count"] == 1000
+    assert s["min"] == samples.min() and s["max"] == samples.max()
+    assert s["mean"] == pytest.approx(samples.mean())
+    assert s["p50"] == pytest.approx(float(np.percentile(samples, 50)))
+
+
+def test_streaming_percentiles_bounded_and_sane_past_capacity():
+    from fedcrack_tpu.obs.metrics import StreamingPercentiles
+
+    sp = StreamingPercentiles(capacity=64, seed=3)
+    samples = np.linspace(0.0, 1000.0, 5000)
+    for v in samples:
+        sp.add(v)
+    assert sp.count == 5000
+    assert len(sp._values) == 64  # memory stays bounded
+    # Exact extremes/mean are tracked outside the reservoir; percentiles are
+    # a uniform-sample estimate — loose sanity bounds, not exactness.
+    s = sp.summary()
+    assert s["min"] == 0.0 and s["max"] == 1000.0
+    assert 300.0 < s["p50"] < 700.0
+    assert s["p95"] > s["p50"]
+    # Deterministic for a fixed (seed, insertion order).
+    sp2 = StreamingPercentiles(capacity=64, seed=3)
+    for v in samples:
+        sp2.add(v)
+    assert sp2.percentile(50.0) == sp.percentile(50.0)
+
+
+def test_streaming_percentiles_empty_and_validation():
+    from fedcrack_tpu.obs.metrics import StreamingPercentiles
+
+    sp = StreamingPercentiles(capacity=8)
+    assert sp.percentile(50.0) is None
+    assert sp.summary()["p99"] is None and sp.summary()["count"] == 0
+    with pytest.raises(ValueError):
+        sp.percentile(101.0)
+    with pytest.raises(ValueError):
+        StreamingPercentiles(capacity=0)
+
+
+# ---- engine: buckets, padding, tiling ----
+
+
+def test_tile_plan_covers_and_is_deterministic():
+    from fedcrack_tpu.serve.engine import tile_plan
+
+    for extent, tile, overlap in [(100, 32, 8), (32, 32, 8), (97, 32, 0), (64, 32, 8)]:
+        offs = tile_plan(extent, tile, overlap)
+        assert offs == tile_plan(extent, tile, overlap)
+        assert offs[0] == 0 and offs[-1] == extent - tile
+        covered = np.zeros(extent, bool)
+        for o in offs:
+            covered[o : o + tile] = True
+        assert covered.all()
+        # every neighbor pair overlaps by at least `overlap` pixels
+        for a, b in zip(offs, offs[1:]):
+            assert b - a <= tile - overlap or b == extent - tile
+    with pytest.raises(ValueError):
+        tile_plan(16, 32, 8)
+    with pytest.raises(ValueError):
+        tile_plan(64, 32, 32)
+
+
+def test_bucket_routing(stack):
+    engine, _, _ = stack
+    assert engine.bucket_for(16, 16) == 16
+    assert engine.bucket_for(10, 14) == 16
+    assert engine.bucket_for(17, 8) == 32
+    assert engine.bucket_for(32, 32) == 32
+    assert engine.bucket_for(33, 8) is None
+    assert engine.n_tiles(32, 32) == 1
+    assert engine.n_tiles(60, 32) == 2
+
+
+def test_pad_lanes_do_not_perturb_real_lanes(stack):
+    """A 1-lane submission padded to the compiled max_batch must return the
+    SAME bytes as the same image inside a full batch — inference-mode BN uses
+    running stats, so lanes are independent (the micro-batcher's padding
+    contract)."""
+    engine, var0, _ = stack
+    dev0 = engine.prepare(var0)
+    imgs = _images(4, 16, seed=1)
+    full = engine.predict_bucket(dev0, imgs)
+    solo = engine.predict_bucket(dev0, imgs[:1])
+    np.testing.assert_array_equal(full[:1], solo)
+
+
+def test_predict_image_pads_and_crops(stack):
+    engine, var0, _ = stack
+    dev0 = engine.prepare(var0)
+    out = engine.predict_image(dev0, _images(1, 16, seed=2)[0][:10, :14])
+    assert out.shape == (10, 14, 1)
+    assert out.dtype == np.float32
+    assert np.isfinite(out).all() and (0.0 <= out).all() and (out <= 1.0).all()
+
+
+def test_tiled_byte_deterministic_and_single_tile_exact(stack):
+    engine, var0, _ = stack
+    dev0 = engine.prepare(var0)
+    rng = np.random.default_rng(5)
+    big = rng.integers(0, 256, (50, 70, 3), dtype=np.uint8)
+    a = engine.predict_tiled(dev0, big)
+    b = engine.predict_tiled(dev0, big)
+    assert a.shape == (50, 70, 1)
+    np.testing.assert_array_equal(a, b)  # byte-deterministic, run to run
+    # A single-tile image (exactly the largest bucket) has blend weight 1
+    # everywhere: the tiled path must equal the plain bucket program bytes.
+    one = _images(1, 32, seed=6)
+    tiled = engine.predict_tiled(dev0, one[0])
+    direct = engine.predict_bucket(dev0, one)[0]
+    np.testing.assert_array_equal(tiled, direct)
+
+
+# ---- batcher: micro-batching, deadlines, swap barrier ----
+
+
+def test_batcher_coalesces_into_one_batch(stack):
+    from fedcrack_tpu.serve import MicroBatcher, StaticWeights
+
+    engine, var0, _ = stack
+    with MicroBatcher(
+        engine, StaticWeights(engine.prepare(var0)), max_delay_ms=200.0
+    ) as b:
+        imgs = _images(4, 16, seed=7)
+        futs = [b.submit(img) for img in imgs]
+        results = [f.result(timeout=60) for f in futs]
+        stats = b.stats()
+    assert stats["completed"] == 4 and stats["batches"] == 1
+    assert stats["per_bucket"] == {"16": 4, "32": 0}
+    assert all(r.model_version == 0 for r in results)
+    # The batch result must equal the engine's direct bytes for the batch.
+    direct = engine.predict_bucket(engine.prepare(var0), imgs)
+    for i, r in enumerate(results):
+        np.testing.assert_array_equal(r.probs, direct[i])
+
+
+def test_batcher_rejects_non_bucket_shapes_and_closed(stack):
+    from fedcrack_tpu.serve import MicroBatcher, StaticWeights
+
+    engine, var0, _ = stack
+    b = MicroBatcher(engine, StaticWeights(engine.prepare(var0)))
+    with pytest.raises(ValueError, match="bucket shapes"):
+        b.submit(np.zeros((20, 20, 3), np.uint8))
+    b.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit(np.zeros((16, 16, 3), np.uint8))
+
+
+def test_batcher_deadline_accounting(stack):
+    from fedcrack_tpu.serve import MicroBatcher, StaticWeights
+
+    engine, var0, _ = stack
+    with MicroBatcher(engine, StaticWeights(engine.prepare(var0))) as b:
+        # An already-expired deadline: still served (never dropped), counted.
+        r = b.submit(_images(1, 16)[0], deadline_ms=1e-6).result(timeout=60)
+        stats = b.stats()
+    assert r.deadline_missed
+    assert stats["deadline_missed"] == 1 and stats["completed"] == 1
+
+
+def test_hot_swap_post_swap_bit_identical_to_cold_start(stack):
+    """The tentpole pin: after a live swap to round-N weights, served bytes
+    == a cold start of the same round's weights (same compiled program, same
+    device values)."""
+    from fedcrack_tpu.serve import MicroBatcher, ModelVersionManager
+
+    engine, var0, var1 = stack
+    imgs = _images(4, 16, seed=8)
+    mgr = ModelVersionManager(engine, var0)
+    with MicroBatcher(engine, mgr, max_delay_ms=200.0) as b:
+        pre = [f.result(timeout=60) for f in [b.submit(i) for i in imgs]]
+        assert mgr.install(1, var1)
+        post = [f.result(timeout=60) for f in [b.submit(i) for i in imgs]]
+    mgr.stop()
+    assert all(r.model_version == 0 for r in pre)
+    assert all(r.model_version == 1 for r in post)
+    cold0 = engine.predict_bucket(engine.prepare(var0), imgs)
+    cold1 = engine.predict_bucket(engine.prepare(var1), imgs)
+    for i in range(4):
+        np.testing.assert_array_equal(pre[i].probs, cold0[i])
+        np.testing.assert_array_equal(post[i].probs, cold1[i])
+    assert mgr.last_swap["to_version"] == 1 and mgr.last_swap["load_ms"] >= 0
+
+
+def test_swap_mid_batch_no_torn_reads(stack):
+    """A batch straddling a swap gets EXACTLY one version's outputs: the
+    chaos hook installs v1 after the worker snapshotted v0, and the whole
+    batch must still answer from v0 (the request-boundary barrier)."""
+    from fedcrack_tpu.chaos import SERVE_SWAP_MIDFLIGHT, Fault, FaultPlan, ServeChaos
+    from fedcrack_tpu.serve import MicroBatcher, ModelVersionManager
+
+    engine, var0, var1 = stack
+    imgs = _images(4, 16, seed=9)
+    mgr = ModelVersionManager(engine, var0)
+    chaos = ServeChaos(
+        FaultPlan(faults=(Fault(kind=SERVE_SWAP_MIDFLIGHT, round=0),)),
+        swap_hook=lambda: mgr.install(1, var1),
+    )
+    with MicroBatcher(engine, mgr, max_delay_ms=200.0, chaos=chaos) as b:
+        batch = [f.result(timeout=60) for f in [b.submit(i) for i in imgs]]
+        after = b.submit(imgs[0]).result(timeout=60)
+    mgr.stop()
+    # The straddled batch: entirely v0, byte-equal to v0 cold outputs.
+    assert {r.model_version for r in batch} == {0}
+    cold0 = engine.predict_bucket(engine.prepare(var0), imgs)
+    for i, r in enumerate(batch):
+        np.testing.assert_array_equal(r.probs, cold0[i])
+    # The NEXT batch picks up the installed version.
+    assert after.model_version == 1
+    np.testing.assert_array_equal(
+        after.probs, engine.predict_bucket(engine.prepare(var1), imgs[:1])[0]
+    )
+    assert mgr.version == 1
+
+
+def test_injected_device_loss_drops_nothing(stack):
+    from fedcrack_tpu.chaos import SERVE_DEVICE_LOSS, Fault, FaultPlan, ServeChaos
+    from fedcrack_tpu.serve import MicroBatcher, ModelVersionManager
+
+    engine, var0, _ = stack
+    mgr = ModelVersionManager(engine, var0)
+    chaos = ServeChaos(
+        FaultPlan(faults=(Fault(kind=SERVE_DEVICE_LOSS, round=0),))
+    )
+    imgs = _images(4, 16, seed=10)
+    with MicroBatcher(engine, mgr, max_delay_ms=200.0, chaos=chaos) as b:
+        results = [f.result(timeout=60) for f in [b.submit(i) for i in imgs]]
+        stats = b.stats()
+    mgr.stop()
+    assert len(results) == 4 and stats["completed"] == 4
+    assert stats["failed"] == 0
+    assert stats["batch_retries"] == 1  # one injected loss, one clean retry
+    cold0 = engine.predict_bucket(engine.prepare(var0), imgs)
+    for i, r in enumerate(results):
+        np.testing.assert_array_equal(r.probs, cold0[i])
+
+
+def test_exhausted_retries_fail_loudly_not_silently(stack):
+    from fedcrack_tpu.serve import MicroBatcher, StaticWeights
+    from fedcrack_tpu.serve.batcher import MAX_BATCH_ATTEMPTS
+
+    engine, var0, _ = stack
+
+    class AlwaysDown:
+        calls = 0
+
+        def on_batch(self, bucket, batch_index, attempt):
+            AlwaysDown.calls += 1
+            raise RuntimeError("device permanently lost")
+
+    with MicroBatcher(
+        engine, StaticWeights(engine.prepare(var0)), chaos=AlwaysDown()
+    ) as b:
+        fut = b.submit(_images(1, 16)[0])
+        with pytest.raises(RuntimeError, match="permanently lost"):
+            fut.result(timeout=60)
+        stats = b.stats()
+    assert AlwaysDown.calls == MAX_BATCH_ATTEMPTS
+    assert stats["failed"] == 1 and stats["completed"] == 0
+
+
+# ---- hot swap: statefile / checkpoint watching ----
+
+
+def test_manager_polls_statefile_and_ignores_stale(stack, tmp_path):
+    from fedcrack_tpu.serve import ModelVersionManager, publish_statefile
+
+    engine, var0, var1 = stack
+    path = str(tmp_path / "server_state.msgpack")
+    mgr = ModelVersionManager(
+        engine, var0, initial_version=5, state_path=path, template=var0
+    )
+    assert mgr.poll_once() is False  # no file yet
+    publish_statefile(path, var1, model_version=3)
+    assert mgr.poll_once() is False  # stale (3 <= 5): never regress
+    assert mgr.version == 5
+    publish_statefile(path, var1, model_version=9)
+    assert mgr.poll_once() is True
+    assert mgr.version == 9
+    out = engine.predict_bucket(mgr.snapshot()[1], _images(2, 16, seed=11))
+    cold1 = engine.predict_bucket(engine.prepare(var1), _images(2, 16, seed=11))
+    np.testing.assert_array_equal(out, cold1)
+    mgr.stop()
+
+
+def test_manager_survives_corrupt_statefile(stack, tmp_path):
+    from fedcrack_tpu.serve import ModelVersionManager
+
+    engine, var0, _ = stack
+    path = tmp_path / "server_state.msgpack"
+    path.write_bytes(b"\x00garbage not msgpack")
+    mgr = ModelVersionManager(engine, var0, state_path=str(path), template=var0)
+    assert mgr.poll_once() is False  # unreadable -> keep current, don't raise
+    assert mgr.version == 0
+    mgr.stop()
+
+
+def test_manager_polls_checkpoint_dir(stack, tmp_path):
+    from fedcrack_tpu.ckpt.manager import FedCheckpoint, FedCheckpointer
+    from fedcrack_tpu.serve import ModelVersionManager
+
+    engine, var0, var1 = stack
+    ckpt_dir = str(tmp_path / "ckpt")
+    with FedCheckpointer(ckpt_dir) as ckptr:
+        ckptr.save(FedCheckpoint(current_round=2, model_version=2, variables=var1))
+    mgr = ModelVersionManager(engine, var0, ckpt_dir=ckpt_dir, template=var0)
+    assert mgr.poll_once() is True
+    assert mgr.version == 2
+    imgs = _images(2, 16, seed=12)
+    np.testing.assert_array_equal(
+        engine.predict_bucket(mgr.snapshot()[1], imgs),
+        engine.predict_bucket(engine.prepare(var1), imgs),
+    )
+    mgr.stop()
+
+
+def test_background_poll_thread_swaps_live(stack, tmp_path):
+    from fedcrack_tpu.serve import ModelVersionManager, publish_statefile
+
+    engine, var0, var1 = stack
+    path = str(tmp_path / "state.msgpack")
+    mgr = ModelVersionManager(
+        engine, var0, state_path=path, poll_s=0.05, template=var0
+    )
+    with mgr:
+        publish_statefile(path, var1, model_version=1)
+        done = threading.Event()
+        for _ in range(200):
+            if mgr.version == 1:
+                done.set()
+                break
+            threading.Event().wait(0.05)
+        assert done.is_set(), "poll thread never installed the published model"
+    assert mgr.last_swap["to_version"] == 1
+
+
+# ---- gRPC front door ----
+
+
+@pytest.fixture(scope="module")
+def grpc_stack(stack):
+    """In-process gRPC serving stack shared by the front-door tests."""
+    from fedcrack_tpu.serve import (
+        MicroBatcher,
+        ModelVersionManager,
+        ServeServer,
+        ServeServerThread,
+        ServeService,
+    )
+
+    engine, var0, _ = stack
+    mgr = ModelVersionManager(engine, var0)
+    batcher = MicroBatcher(engine, mgr, max_delay_ms=5.0)
+    server = ServeServer(ServeService(engine, batcher, mgr), port=0)
+    with ServeServerThread(server) as thread:
+        yield thread.port, mgr, batcher
+    batcher.close()
+    mgr.stop()
+
+
+def test_front_door_serves_all_routes_zero_drops(grpc_stack):
+    """Closed-loop load over both buckets plus a non-bucket size (pad+crop
+    route) through the real socket: every request answered, zero drops."""
+    from fedcrack_tpu.tools.load_gen import run_load
+
+    port, _, _ = grpc_stack
+    summary = run_load(
+        f"127.0.0.1:{port}",
+        mode="closed",
+        n_requests=12,
+        concurrency=3,
+        sizes=(16, 32),
+        seed=0,
+    )
+    assert summary["completed"] == 12
+    assert summary["dropped"] == 0 and summary["rejected"] == 0
+    assert set(summary["per_size"]) == {"16x16", "32x32"}
+    assert summary["latency_ms"]["count"] == 12
+    assert summary["latency_ms"]["p99"] >= summary["latency_ms"]["p50"] > 0
+
+
+def test_front_door_live_swap_two_versions_observed(grpc_stack, tmp_path):
+    """The acceptance-shaped smoke, in-process: a hot swap lands mid-run and
+    the client observes BOTH versions with zero drops."""
+    import jax
+
+    from fedcrack_tpu.configs import ModelConfig
+    from fedcrack_tpu.models.resunet import init_variables
+    from fedcrack_tpu.tools.load_gen import run_load
+
+    port, mgr, _ = grpc_stack
+    base = mgr.version
+    var_new = init_variables(jax.random.key(42), ModelConfig(**TINY_KW))
+    state = {"n": 0}
+
+    def on_complete():
+        state["n"] += 1
+        if state["n"] == 8:
+            assert mgr.install(base + 1, var_new)
+
+    summary = run_load(
+        f"127.0.0.1:{port}",
+        mode="closed",
+        n_requests=24,
+        concurrency=2,
+        sizes=(16, 32),
+        seed=1,
+        on_complete=on_complete,
+    )
+    assert summary["completed"] == 24 and summary["dropped"] == 0
+    versions = {int(v) for v in summary["versions_observed"]}
+    assert versions == {base, base + 1}
+
+
+def test_front_door_open_loop_mode(grpc_stack):
+    from fedcrack_tpu.tools.load_gen import run_load
+
+    port, _, _ = grpc_stack
+    summary = run_load(
+        f"127.0.0.1:{port}",
+        mode="open",
+        n_requests=8,
+        rate_rps=200.0,
+        sizes=(16,),
+        seed=2,
+        timeout_s=60.0,
+    )
+    assert summary["completed"] == 8 and summary["dropped"] == 0
+
+
+def test_front_door_rejects_bad_requests(grpc_stack):
+    """Protocol-level rejects: wrong channel count, bad CRC, byte-count
+    mismatch — each rejects THAT request with a reason, stream stays up."""
+    import grpc as grpc_mod
+
+    from fedcrack_tpu.transport import transport_pb2 as pb
+    from fedcrack_tpu.transport.service import channel_options
+    from fedcrack_tpu.serve.service import OK, PREDICT_PATH, REJECTED
+
+    port, _, _ = grpc_stack
+    channel = grpc_mod.insecure_channel(
+        f"127.0.0.1:{port}", options=channel_options(8)
+    )
+    try:
+        grpc_mod.channel_ready_future(channel).result(timeout=30)
+        stub = channel.stream_stream(
+            PREDICT_PATH,
+            request_serializer=pb.PredictRequest.SerializeToString,
+            response_deserializer=pb.PredictResponse.FromString,
+        )
+        img = _images(1, 16, seed=3)[0]
+        reqs = [
+            # 1) wrong channels
+            pb.PredictRequest(
+                request_id=1, height=16, width=16, channels=4,
+                image=b"\0" * (16 * 16 * 4), offset=0, last=True,
+            ),
+            # 2) CRC mismatch
+            pb.PredictRequest(
+                request_id=2, height=16, width=16, channels=3,
+                image=img.tobytes(), offset=0, last=True, crc32c=0xDEADBEEF,
+            ),
+            # 3) byte-count mismatch
+            pb.PredictRequest(
+                request_id=3, height=16, width=16, channels=3,
+                image=img.tobytes()[:100], offset=0, last=True,
+            ),
+            # 4) a good one: the stream must still be serving
+            pb.PredictRequest(
+                request_id=4, height=16, width=16, channels=3,
+                image=img.tobytes(), offset=0, last=True,
+            ),
+        ]
+        responses = list(stub(iter(reqs)))
+    finally:
+        channel.close()
+    by_id = {r.request_id: r for r in responses}
+    assert by_id[1].status == REJECTED and "channels" in by_id[1].title
+    assert by_id[2].status == REJECTED and "checksum" in by_id[2].title
+    assert by_id[3].status == REJECTED
+    assert by_id[4].status == OK
+    assert len(by_id[4].mask) == 16 * 16
+    mask = np.frombuffer(by_id[4].mask, np.uint8)
+    assert set(np.unique(mask)) <= {0, 255}
+
+
+def test_front_door_one_response_per_multichunk_reject(grpc_stack):
+    """Exactly ONE response per request_id, even when a MIDDLE chunk of a
+    multi-chunk request is rejected: later chunks of the dead request are
+    swallowed (clients count responses 1:1 with requests — a second REJECTED
+    for the same id would desynchronize every closed-loop client behind it)."""
+    import grpc as grpc_mod
+
+    from fedcrack_tpu.native import crc32c
+    from fedcrack_tpu.serve.service import OK, PREDICT_PATH, REJECTED
+    from fedcrack_tpu.transport import transport_pb2 as pb
+    from fedcrack_tpu.transport.service import channel_options
+
+    port, _, _ = grpc_stack
+    img = _images(1, 16, seed=4)[0]
+    blob = img.tobytes()
+    third = len(blob) // 3
+
+    def chunk(rid, piece, offset, last, bad_crc=False):
+        return pb.PredictRequest(
+            request_id=rid, height=16, width=16, channels=3,
+            image=piece, offset=offset, last=last,
+            crc32c=0xBAD0BAD0 if bad_crc else crc32c(piece),
+        )
+
+    reqs = [
+        # request 1: 3 chunks, the MIDDLE one fails CRC; the tail chunk of
+        # the now-dead request must produce no extra response.
+        chunk(1, blob[:third], 0, False),
+        chunk(1, blob[third : 2 * third], third, False, bad_crc=True),
+        chunk(1, blob[2 * third :], 2 * third, True),
+        # request 2: well-formed, must still be served in sync.
+        chunk(2, blob, 0, True),
+    ]
+    channel = grpc_mod.insecure_channel(
+        f"127.0.0.1:{port}", options=channel_options(8)
+    )
+    try:
+        grpc_mod.channel_ready_future(channel).result(timeout=30)
+        stub = channel.stream_stream(
+            PREDICT_PATH,
+            request_serializer=pb.PredictRequest.SerializeToString,
+            response_deserializer=pb.PredictResponse.FromString,
+        )
+        responses = list(stub(iter(reqs)))
+    finally:
+        channel.close()
+    assert [r.request_id for r in responses] == [1, 2]
+    assert responses[0].status == REJECTED and "checksum" in responses[0].title
+    assert responses[1].status == OK and len(responses[1].mask) == 16 * 16
+
+
+# ---- generated pb2 cannot drift from transport.proto ----
+
+
+def test_pb2_serving_descriptors_match_proto():
+    """The checked-in transport_pb2 was regenerated by descriptor surgery
+    (regen_pb2.py — no protoc in this image). Pin both directions: the live
+    module's serving descriptors equal the regen script's DescriptorProtos,
+    and every declared field appears in transport.proto's text with the same
+    tag number."""
+    import os
+    import re
+
+    from fedcrack_tpu.transport import regen_pb2
+    from fedcrack_tpu.transport import transport_pb2 as pb
+
+    for make, cls in [
+        (regen_pb2._predict_request, pb.PredictRequest),
+        (regen_pb2._predict_response, pb.PredictResponse),
+    ]:
+        want = make()
+        have = cls.DESCRIPTOR
+        want_fields = {(f.name, f.number, f.type) for f in want.field}
+        have_fields = {(f.name, f.number, f.type) for f in have.fields}
+        assert want_fields == have_fields, cls.__name__
+
+    svc = pb.DESCRIPTOR.services_by_name["ServePlane"]
+    method = svc.methods_by_name["Predict"]
+    assert method.input_type is pb.PredictRequest.DESCRIPTOR
+    assert method.output_type is pb.PredictResponse.DESCRIPTOR
+
+    proto_path = os.path.join(os.path.dirname(regen_pb2.__file__), "transport.proto")
+    with open(proto_path) as f:
+        text = f.read()
+    assert "service ServePlane" in text
+    for msg in (regen_pb2._predict_request(), regen_pb2._predict_response()):
+        assert f"message {msg.name}" in text
+        for field in msg.field:
+            assert re.search(
+                rf"\b{field.name}\s*=\s*{field.number}\b", text
+            ), f"{msg.name}.{field.name} = {field.number} missing from transport.proto"
+
+
+def test_regen_is_idempotent_against_checked_in_module():
+    """Re-running the descriptor surgery over the checked-in module must be
+    a no-op: everything it would add is already present."""
+    from fedcrack_tpu.transport import regen_pb2
+
+    fdp = regen_pb2.build_file_descriptor()
+    assert fdp.SerializeToString() == regen_pb2.current_serialized_pb()
+
+
+# ---- ServeConfig validation (configs satellite rides here too) ----
+
+
+def test_serve_config_validation():
+    from fedcrack_tpu.configs import ServeConfig
+
+    with pytest.raises(ValueError, match="multiple of 16"):
+        ServeConfig(bucket_sizes=(100,))
+    with pytest.raises(ValueError, match="strictly increasing"):
+        ServeConfig(bucket_sizes=(256, 128))
+    with pytest.raises(ValueError, match="must not be empty"):
+        ServeConfig(bucket_sizes=())
+    with pytest.raises(ValueError, match="tile_overlap"):
+        ServeConfig(bucket_sizes=(128,), tile_overlap=128)
+    with pytest.raises(ValueError, match="max_batch"):
+        ServeConfig(max_batch=0)
+    with pytest.raises(ValueError, match="mesh_batch"):
+        ServeConfig(max_batch=8, mesh_batch=3)
+    with pytest.raises(ValueError, match="compute_dtype"):
+        ServeConfig(compute_dtype="float16")
+    with pytest.raises(ValueError, match="swap_poll_s"):
+        ServeConfig(swap_poll_s=0.0)

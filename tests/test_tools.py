@@ -69,6 +69,91 @@ def test_predict_and_quantify_writes_outputs(tmp_path):
     assert all("area_px" in r for r in reports)
 
 
+def _write_mask_pngs(out_dir, specs):
+    """specs: {name: (size, fill_box or None)} -> PNG masks on disk."""
+    import os
+
+    import cv2
+
+    os.makedirs(out_dir, exist_ok=True)
+    for name, (size, box) in specs.items():
+        mask = np.zeros((size, size), np.uint8)
+        if box is not None:
+            y0, y1, x0, x1 = box
+            mask[y0:y1, x0:x1] = 255
+        cv2.imwrite(str(out_dir / name), mask)
+
+
+def test_quantify_mask_dir_batch_stats(tmp_path):
+    """Round-10 batch mode: a directory of predicted masks (what the serving
+    plane emits via load_gen --out-dir) quantified WITHOUT a model, with
+    per-image records in stable sorted order plus aggregate totals."""
+    from fedcrack_tpu.tools.quantify import quantify_mask_dir
+
+    _write_mask_pngs(
+        tmp_path,
+        {
+            "mask_00002.png": (64, (20, 40, 20, 40)),  # one 20x20 crack
+            "mask_00000.png": (64, None),              # empty
+            "mask_00001.png": (64, (5, 15, 5, 15)),    # one 10x10 crack
+        },
+    )
+    (tmp_path / "notes.txt").write_text("not a mask")  # ignored (not an image)
+    report = quantify_mask_dir(str(tmp_path))
+    names = [r["image"] for r in report["images"]]
+    assert names == ["mask_00000.png", "mask_00001.png", "mask_00002.png"]
+    assert report["images"][0]["contours"] == 0
+    assert report["images"][1]["contours"] == 1
+    assert report["totals"]["images"] == 3
+    assert report["totals"]["contours"] == 2
+    assert report["totals"]["area_px"] == pytest.approx(
+        sum(r["area_px"] for r in report["images"])
+    )
+    assert report["totals"]["mean_crack_fraction"] == pytest.approx(
+        np.mean([r["crack_fraction"] for r in report["images"]])
+    )
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(ValueError, match="no mask images"):
+        quantify_mask_dir(str(empty))
+    with pytest.raises(ValueError, match="not a directory"):
+        quantify_mask_dir(str(tmp_path / "does_not_exist"))
+
+
+def test_quantify_cli_pred_dir_out_json(tmp_path, capsys):
+    """The CLI contract the serving pipeline uses: --pred-dir needs no
+    --weights, prints one JSON line per image + a totals line, and --out-json
+    writes the machine-readable report."""
+    import json
+
+    from fedcrack_tpu.tools.quantify import main as quantify_main
+
+    pred = tmp_path / "pred"
+    _write_mask_pngs(pred, {"a.png": (32, (8, 16, 8, 24)), "b.png": (32, None)})
+    out_json = tmp_path / "stats.json"
+    quantify_main(
+        ["--pred-dir", str(pred), "--out-json", str(out_json)]
+    )
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 3  # 2 per-image lines + totals
+    per_image = [json.loads(line) for line in lines[:2]]
+    assert [r["image"] for r in per_image] == ["a.png", "b.png"]
+    totals = json.loads(lines[-1])["totals"]
+    assert totals["images"] == 2 and totals["contours"] == 1
+    with open(out_json) as f:
+        on_disk = json.load(f)
+    assert on_disk["totals"] == totals
+    assert [r["image"] for r in on_disk["images"]] == ["a.png", "b.png"]
+
+
+def test_quantify_cli_weights_still_required_without_pred_dir(capsys):
+    from fedcrack_tpu.tools.quantify import main as quantify_main
+
+    with pytest.raises(SystemExit):
+        quantify_main(["--synthetic", "2"])
+    assert "--weights is required" in capsys.readouterr().err
+
+
 @pytest.mark.slow
 def test_refscale_federation_tool_smoke(tmp_path):
     """The reference-complete federation driver (tools/refscale_federation)
