@@ -1,0 +1,63 @@
+"""fedlint — the repo-native static-analysis + runtime-sanitizer plane.
+
+Rounds 6-10 turned this codebase's headline guarantees into *invariants*:
+byte-identical trajectories across the segmented/resident/streamed data
+paths, no torn reads across hot-swaps, fsync'd atomic statefiles,
+monotonic-clock deadlines, bounded gRPC retries. Every one of them can be
+silently re-opened by a single careless line in a later PR — a
+``time.time()`` deadline, an unsorted ``os.listdir``, a raw
+``open(path, "wb")`` on a checkpoint path. The reference codebase is the
+cautionary tale: seven documented accidents (pickle RCE, a commented-out
+uploader, a ``grcp.`` typo) that a mechanical checker would have caught.
+
+This package is that checker, in two halves:
+
+- **static** (``engine`` + ``rules/``): an AST-level lint engine with
+  repo-specific rule packs — determinism, durability, trace-safety,
+  transport, lock-order, dead-code — driven by ``tools/fedlint.py`` and
+  pinned at zero non-baselined findings by a tier-1 gate test;
+- **runtime** (``sanitizers``): a :class:`RecompileSentry` asserting
+  steady-state rounds and serve programs compile exactly once, a
+  ``jax.transfer_guard`` wrapper armed around the mesh round and batcher
+  dispatch in tier-1 tests, and a debug-mode lock-order monitor that
+  records acquisition stacks.
+
+Suppression syntax (checked by the engine, see ``engine.py``)::
+
+    x = time.time()  # fedlint: disable=DET001 -- human-readable record ts
+
+Baseline: ``fedlint_baseline.json`` at the repo root carries the findings
+that are accepted-as-is (each entry fingerprinted against the offending
+source line, so the baseline goes stale — and the gate fails — the moment
+the line changes).
+"""
+
+from fedcrack_tpu.analysis.engine import (
+    Finding,
+    LintEngine,
+    ModuleSource,
+    Severity,
+    load_baseline,
+    make_baseline,
+)
+from fedcrack_tpu.analysis.sanitizers import (
+    LockOrderMonitor,
+    RecompileError,
+    RecompileSentry,
+    make_lock,
+    no_implicit_transfers,
+)
+
+__all__ = [
+    "Finding",
+    "LintEngine",
+    "LockOrderMonitor",
+    "ModuleSource",
+    "RecompileError",
+    "RecompileSentry",
+    "Severity",
+    "load_baseline",
+    "make_baseline",
+    "make_lock",
+    "no_implicit_transfers",
+]

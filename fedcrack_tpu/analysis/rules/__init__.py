@@ -1,0 +1,30 @@
+"""fedlint rule registry.
+
+Each rule pack module exports ``RULES``; ``all_rules()`` instantiates the
+full set in a stable order. ``rules_by_id`` powers the CLI's ``--rules``
+filter and ``--list-rules``.
+"""
+
+from __future__ import annotations
+
+from fedcrack_tpu.analysis.engine import Rule
+
+
+def all_rules() -> list[Rule]:
+    from fedcrack_tpu.analysis.rules import (
+        deadcode,
+        determinism,
+        durability,
+        locks,
+        trace,
+        transport,
+    )
+
+    out: list[Rule] = []
+    for pack in (determinism, durability, trace, transport, locks, deadcode):
+        out.extend(cls() for cls in pack.RULES)
+    return out
+
+
+def rules_by_id() -> dict[str, Rule]:
+    return {r.id: r for r in all_rules()}

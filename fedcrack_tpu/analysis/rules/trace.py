@@ -1,0 +1,143 @@
+"""Trace-safety rule pack.
+
+**TRACE001**: host operations inside functions that XLA traces. A
+``.item()``, ``float(...)``, ``np.*`` call or ``print`` inside a
+``jit``/``shard_map``/``scan``-transformed function either fails at trace
+time or — worse — silently forces a device→host transfer and a pipeline
+stall every step (the implicit-transfer class that torpedoes round wall;
+the runtime twin is ``analysis.sanitizers.no_implicit_transfers``).
+
+A function counts as *traced* when it is
+
+- decorated with ``jit`` / ``jax.jit`` / ``partial(jax.jit, ...)`` /
+  ``shard_map`` / ``jax.remat`` / ``checkpoint``; or
+- passed **by name** to a ``jit(...)`` / ``shard_map(...)`` /
+  ``lax.scan(...)`` / ``pjit``/``remat`` call anywhere in the module; or
+- lexically nested inside a traced function (closures over the carry).
+
+Scoped to the mesh round and serve planes (``parallel/``,
+``serve/engine.py``) where every hot function is traced; host-side drivers
+legitimately mix numpy with device code.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from fedcrack_tpu.analysis.engine import Finding, ModuleSource, Rule, Severity
+from fedcrack_tpu.analysis.rules._ast_util import call_name, terminal_name
+
+TRANSFORM_NAMES = {"jit", "pjit", "shard_map", "scan", "remat", "checkpoint"}
+
+HOST_CALLS = {"print", "input", "breakpoint"}
+HOST_CASTS = {"float", "int", "bool"}
+HOST_MODULES = {"np", "numpy"}
+
+
+def _decorator_is_transform(dec: ast.expr) -> bool:
+    if isinstance(dec, ast.Call):
+        # @partial(jax.jit, ...) / @jax.jit(...)
+        if terminal_name(dec) == "partial" and dec.args:
+            return terminal_name(dec.args[0]) in TRANSFORM_NAMES
+        return terminal_name(dec) in TRANSFORM_NAMES
+    return terminal_name(dec) in TRANSFORM_NAMES
+
+
+class TracedHostOpRule(Rule):
+    id = "TRACE001"
+    severity = Severity.ERROR
+    description = (
+        "host op (.item()/float()/np.*/print) inside a jit/shard_map/scan-"
+        "transformed function: trace-time failure or an implicit transfer "
+        "stalling every step"
+    )
+    paths = ("/parallel/", "/serve/engine.py")
+
+    def check(self, module: ModuleSource) -> Iterable[Finding]:
+        traced = self._traced_functions(module)
+        reported: set[tuple[int, int]] = set()
+        for fn in traced:
+            for f in self._host_ops(module, fn):
+                key = (f.line, f.col)
+                if key not in reported:
+                    reported.add(key)
+                    yield f
+
+    def _traced_functions(self, module: ModuleSource) -> list[ast.AST]:
+        funcs = [
+            n for n in ast.walk(module.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        by_name: dict[str, list[ast.AST]] = {}
+        for fn in funcs:
+            by_name.setdefault(fn.name, []).append(fn)
+        traced: set[ast.AST] = set()
+        # Decorated.
+        for fn in funcs:
+            if any(_decorator_is_transform(d) for d in fn.decorator_list):
+                traced.add(fn)
+        # Passed by name to a transform call.
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if terminal_name(node) not in TRANSFORM_NAMES:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in by_name:
+                    traced.update(by_name[arg.id])
+        # Lexical nesting: a def inside a traced def is traced.
+        changed = True
+        while changed:
+            changed = False
+            for fn in funcs:
+                if fn in traced:
+                    continue
+                for anc in module.ancestors(fn):
+                    if anc in traced:
+                        traced.add(fn)
+                        changed = True
+                        break
+        return [fn for fn in funcs if fn in traced]
+
+    def _host_ops(self, module: ModuleSource, fn: ast.AST) -> Iterable[Finding]:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            term = terminal_name(node)
+            if term == "item" and isinstance(node.func, ast.Attribute) and not node.args:
+                yield self.finding(
+                    module, node,
+                    ".item() forces a device->host transfer inside a traced "
+                    "function",
+                )
+            elif name in HOST_CALLS:
+                yield self.finding(
+                    module, node,
+                    f"{name}() is a host side effect inside a traced function "
+                    "— use jax.debug.print / host_callback if intentional",
+                )
+            elif name in HOST_CASTS and node.args and not isinstance(
+                node.args[0], ast.Constant
+            ):
+                yield self.finding(
+                    module, node,
+                    f"{name}() on a traced value forces concretization — use "
+                    "jnp casts (x.astype) instead",
+                )
+            elif name is not None and name.split(".")[0] in HOST_MODULES:
+                yield self.finding(
+                    module, node,
+                    f"{name}() runs on host inside a traced function — use "
+                    "the jnp equivalent",
+                )
+            elif name in ("jax.device_get", "jax.device_put"):
+                yield self.finding(
+                    module, node,
+                    f"{name}() inside a traced function is a transfer in the "
+                    "hot loop",
+                )
+
+
+RULES = (TracedHostOpRule,)

@@ -1,0 +1,259 @@
+"""Runtime sanitizers — the dynamic twins of the static rule packs.
+
+Three contracts the lint engine can only approximate statically get a
+runtime assertion here:
+
+- :class:`RecompileSentry` (twin of TRACE001's intent): steady-state rounds
+  and per-bucket serve programs must compile exactly once. A stray python
+  float in a carry, a shape drifting by one, or a weights pytree whose
+  structure changes across a hot-swap silently triggers a retrace — turning
+  the pointer-flip swap into a multi-second XLA pause. The sentry watches
+  ``jax.jit`` cache sizes and fails loudly on unexpected growth.
+- :func:`no_implicit_transfers` (twin of TRACE001): arms
+  ``jax.transfer_guard("disallow")`` so any *implicit* host<->device
+  transfer inside the guarded span raises instead of stalling the pipeline.
+  Explicit ``device_put``/``device_get`` (the staged paths) still work —
+  exactly the discipline the mesh round and batcher dispatch claim to have.
+- :class:`LockOrderMonitor` + :func:`make_lock` (twin of LOCK001): a
+  lockdep-style order recorder. Locks built through ``make_lock(name)`` are
+  plain ``threading.Lock`` objects in production; with a monitor installed
+  (tests, or ``FEDCRACK_LOCK_DEBUG=1``) every acquisition records the
+  per-thread held stack, and acquiring A-then-B after B-then-A was ever
+  observed raises :class:`LockOrderViolation` with both acquisition stacks —
+  catching the inversion even when the timing never actually deadlocks.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import traceback
+from typing import Any, Iterator
+
+
+class RecompileError(AssertionError):
+    """A watched jit function compiled when the contract said it must not."""
+
+
+class RecompileSentry:
+    """Asserts jit-cache stability over watched functions.
+
+    Usage::
+
+        sentry = RecompileSentry()
+        sentry.watch("serve.predict", engine._fn)
+        engine.warmup(variables)          # compiles (one entry per bucket)
+        sentry.mark()                     # steady state begins here
+        ... serve traffic / hot-swap ...
+        sentry.assert_steady()            # zero recompiles since mark()
+
+    or as a span::
+
+        with sentry.expect(compiles=0):
+            batcher-driven traffic
+
+    Counting uses the jit wrapper's ``_cache_size()`` (one entry per traced
+    (shapes, dtypes, shardings) signature — jax>=0.4 exposes it on the
+    ``jax.jit`` return value). ``supported()`` reports availability so tests
+    can skip on exotic builds instead of failing.
+    """
+
+    def __init__(self) -> None:
+        self._watched: dict[str, Any] = {}
+        self._marks: dict[str, int] = {}
+
+    @staticmethod
+    def supported(fn: Any = None) -> bool:
+        if fn is not None:
+            return hasattr(fn, "_cache_size")
+        import jax
+
+        probe = jax.jit(lambda x: x)
+        return hasattr(probe, "_cache_size")
+
+    def watch(self, name: str, fn: Any) -> None:
+        if not hasattr(fn, "_cache_size"):
+            raise TypeError(
+                f"{name}: object has no _cache_size(); pass the jax.jit "
+                "wrapper itself (e.g. engine._fn), not a bound method"
+            )
+        self._watched[name] = fn
+        self._marks[name] = fn._cache_size()
+
+    def counts(self) -> dict[str, int]:
+        return {name: fn._cache_size() for name, fn in self._watched.items()}
+
+    def mark(self) -> None:
+        """Steady state begins now: subsequent deltas are violations."""
+        self._marks = self.counts()
+
+    def deltas(self) -> dict[str, int]:
+        return {
+            name: count - self._marks[name]
+            for name, count in self.counts().items()
+        }
+
+    def assert_steady(self) -> None:
+        grew = {n: d for n, d in self.deltas().items() if d != 0}
+        if grew:
+            raise RecompileError(
+                f"unexpected recompiles since mark(): {grew} — a shape, "
+                "dtype, or pytree-structure drift is retracing a program "
+                "the contract says compiles exactly once"
+            )
+
+    @contextlib.contextmanager
+    def expect(self, compiles: int = 0) -> Iterator["RecompileSentry"]:
+        before = self.counts()
+        yield self
+        after = self.counts()
+        total = sum(after.values()) - sum(before.values())
+        if total != compiles:
+            per_fn = {n: after[n] - before[n] for n in after
+                      if after[n] != before[n]}
+            raise RecompileError(
+                f"expected exactly {compiles} compiles in this span, "
+                f"observed {total} ({per_fn or 'none'})"
+            )
+
+
+@contextlib.contextmanager
+def no_implicit_transfers() -> Iterator[None]:
+    """Raise on any implicit host<->device transfer inside the span.
+
+    Explicit ``jax.device_put`` / ``jax.device_get`` remain allowed — the
+    guarded code is exactly the staged discipline the mesh round and the
+    batcher dispatch promise. No-op on jax builds without transfer_guard.
+    """
+    import jax
+
+    guard = getattr(jax, "transfer_guard", None)
+    if guard is None:
+        yield
+        return
+    with guard("disallow"):
+        yield
+
+
+# ---- lock-order runtime monitor ----
+
+
+class LockOrderViolation(RuntimeError):
+    """Two locks were acquired in both orders — a latent deadlock."""
+
+
+class LockOrderMonitor:
+    """Records lock-acquisition order edges with stacks; raises on inversion.
+
+    The check runs BEFORE blocking on the real lock, so a would-be deadlock
+    surfaces as an exception with both stacks instead of a hang.
+    """
+
+    def __init__(self) -> None:
+        self._held = threading.local()
+        self._edges: dict[tuple[str, str], str] = {}
+        self._edge_lock = threading.Lock()
+
+    def _stack(self) -> list[str]:
+        if not hasattr(self._held, "stack"):
+            self._held.stack = []
+        return self._held.stack
+
+    def on_acquire(self, name: str) -> None:
+        held = self._stack()
+        if not held:
+            # Leaf acquisition (the common case — every current lock in the
+            # repo): no edge to record, so skip the stack capture entirely.
+            held.append(name)
+            return
+        stack_txt = "".join(traceback.format_stack(limit=12))
+        for h in held:
+            if h == name:
+                continue
+            edge, rev = (h, name), (name, h)
+            with self._edge_lock:
+                if rev in self._edges and edge not in self._edges:
+                    raise LockOrderViolation(
+                        f"lock order inversion: acquiring {name!r} while "
+                        f"holding {h!r}, but the opposite order was recorded "
+                        f"earlier.\n--- this acquisition ---\n{stack_txt}"
+                        f"--- earlier {rev[0]!r}->{rev[1]!r} ---\n"
+                        f"{self._edges[rev]}"
+                    )
+                self._edges.setdefault(edge, stack_txt)
+        held.append(name)
+
+    def on_release(self, name: str) -> None:
+        held = self._stack()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                return
+
+    def edges(self) -> set[tuple[str, str]]:
+        with self._edge_lock:
+            return set(self._edges)
+
+
+class _MonitoredLock:
+    """threading.Lock plus order recording. API-compatible with the subset
+    the repo uses (context manager, acquire/release, locked)."""
+
+    def __init__(self, name: str, monitor: LockOrderMonitor):
+        self._name = name
+        self._monitor = monitor
+        self._lock = threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        self._monitor.on_acquire(self._name)
+        got = self._lock.acquire(blocking, timeout)
+        if not got:
+            self._monitor.on_release(self._name)
+        return got
+
+    def release(self) -> None:
+        self._lock.release()
+        self._monitor.on_release(self._name)
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+_monitor: LockOrderMonitor | None = None
+_monitor_lock = threading.Lock()
+
+
+def install_monitor() -> LockOrderMonitor:
+    """Turn on lock-order monitoring for locks created AFTER this call."""
+    global _monitor
+    with _monitor_lock:
+        if _monitor is None:
+            _monitor = LockOrderMonitor()
+        return _monitor
+
+
+def uninstall_monitor() -> None:
+    global _monitor
+    with _monitor_lock:
+        _monitor = None
+
+
+def make_lock(name: str):
+    """The serve plane's lock factory. Plain ``threading.Lock()`` unless a
+    monitor is installed (or ``FEDCRACK_LOCK_DEBUG=1``), in which case the
+    lock records acquisition order under ``name``. Production overhead of
+    debug-off mode: one module-global read at construction time, zero per
+    acquisition."""
+    mon = _monitor
+    if mon is None and os.environ.get("FEDCRACK_LOCK_DEBUG"):
+        mon = install_monitor()
+    if mon is None:
+        return threading.Lock()
+    return _MonitoredLock(name, mon)

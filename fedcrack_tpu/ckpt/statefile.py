@@ -23,7 +23,6 @@ on a fresh boot).
 from __future__ import annotations
 
 import logging
-import os
 from typing import Any
 
 import msgpack
@@ -58,7 +57,11 @@ def server_state_to_bytes(state: Any) -> bytes:
         "failed_rounds": int(state.failed_rounds),
         "global_blob": state.global_blob,
         "received": {
-            name: [blob, int(ns)] for name, (blob, ns) in state.received.items()
+            # Sorted so the statefile bytes are a function of the state, not
+            # of upload arrival order — two snapshots of the same round hash
+            # identically.
+            name: [blob, int(ns)]
+            for name, (blob, ns) in sorted(state.received.items())
         },
         "logs": dict(state.logs),
         "history": [dict(h) for h in state.history],

@@ -60,6 +60,8 @@ class MetricsLogger:
         record = {
             "kind": kind,
             "t": round(time.monotonic() - self._t0, 6),
+            # interval math uses the monotonic "t" above; "ts" is display-only
+            # fedlint: disable=DET001 -- human-readable record timestamp
             "ts": time.time(),
         }
         for k, v in fields.items():

@@ -39,6 +39,7 @@ from typing import Any
 
 import numpy as np
 
+from fedcrack_tpu.analysis.sanitizers import make_lock
 from fedcrack_tpu.obs.metrics import StreamingPercentiles
 
 # Bounded batch retries under injected/real device failures: a request is
@@ -107,7 +108,7 @@ class MicroBatcher:
         }
         self.latency = StreamingPercentiles(reservoir_capacity)
         self.queue_latency = StreamingPercentiles(reservoir_capacity)
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.batcher.stats")
         self._counts = {
             "submitted": 0,
             "completed": 0,

@@ -32,6 +32,8 @@ from typing import Any
 
 import msgpack
 
+from fedcrack_tpu.analysis.sanitizers import make_lock
+
 log = logging.getLogger("fedcrack.serve.hot_swap")
 
 
@@ -126,7 +128,7 @@ class ModelVersionManager:
         self._poll_s = poll_s
         self._template = template
         self._metrics = metrics
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.hot_swap.snapshot")
         self._current = (int(initial_version), engine.prepare(initial_variables))
         self._ckptr = None
         self.swaps: list[dict] = []
@@ -217,7 +219,11 @@ class ModelVersionManager:
             "from_version": current_version,
             "to_version": version,
             "load_ms": round(load_ms, 3),
-            "t": time.time(),
+            # Deadline/interval math above is monotonic (t0/load_ms); the
+            # wall clock appears ONLY as this display field, named "ts" per
+            # the obs JSONL convention ("t" = monotonic there).
+            # fedlint: disable=DET001 -- human-readable record timestamp
+            "ts": time.time(),
         }
         self.swaps.append(record)
         self.last_swap = record

@@ -29,6 +29,7 @@ from typing import Any, AsyncIterator
 import grpc
 import numpy as np
 
+from fedcrack_tpu.analysis.sanitizers import make_lock
 from fedcrack_tpu.transport import transport_pb2 as pb
 from fedcrack_tpu.transport.service import channel_options
 
@@ -70,7 +71,7 @@ class ServeService:
         self.engine = engine
         self.batcher = batcher
         self.weights = weights
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.service.stats")
         self.tiled_served = 0
         self.rejected = 0
 

@@ -267,6 +267,7 @@ def main(argv: list[str] | None = None) -> int:
     import argparse
 
     from fedcrack_tpu.fed.serialization import tree_to_bytes
+    from fedcrack_tpu.ioutils import atomic_write_bytes
 
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("h5_path")
@@ -282,8 +283,7 @@ def main(argv: list[str] | None = None) -> int:
     else:
         config = ModelConfig(img_size=args.img_size)
     variables = import_resunet_h5(args.h5_path, config)
-    with open(args.out_path, "wb") as f:
-        f.write(tree_to_bytes(variables))
+    atomic_write_bytes(args.out_path, tree_to_bytes(variables))
     print(f"imported {args.h5_path} -> {args.out_path}")
     return 0
 

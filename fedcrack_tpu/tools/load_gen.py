@@ -32,7 +32,7 @@ import json
 import threading
 import time
 from queue import Empty, Queue
-from typing import Any, Sequence
+from typing import Sequence
 
 import numpy as np
 

@@ -18,6 +18,7 @@ import jax
 
 from fedcrack_tpu.configs import ModelConfig
 from fedcrack_tpu.fed.serialization import tree_to_bytes
+from fedcrack_tpu.ioutils import atomic_write_bytes
 from fedcrack_tpu.train.local import (
     TrainState,
     create_train_state,
@@ -215,9 +216,7 @@ def main(argv=None) -> None:
 
 
 def _save(state: TrainState, path: str) -> None:
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "wb") as f:
-        f.write(tree_to_bytes(state.variables))
+    atomic_write_bytes(path, tree_to_bytes(state.variables))
 
 
 if __name__ == "__main__":

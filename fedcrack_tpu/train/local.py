@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import optax
 from flax import core, struct
 
-from fedcrack_tpu.configs import FedConfig, ModelConfig
+from fedcrack_tpu.configs import ModelConfig
 from fedcrack_tpu.data.pipeline import as_model_batch, normalize_images
 from fedcrack_tpu.fed.algorithms import fedprox_penalty
 from fedcrack_tpu.models import ResUNet

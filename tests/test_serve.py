@@ -683,3 +683,76 @@ def test_serve_config_validation():
         ServeConfig(compute_dtype="float16")
     with pytest.raises(ValueError, match="swap_poll_s"):
         ServeConfig(swap_poll_s=0.0)
+
+
+# ---- runtime sanitizers on the serve plane (round 11) ----
+
+
+def test_recompile_sentry_one_program_per_bucket_swap_is_pointer_flip(stack):
+    """The serving compile contract, mechanically: a fresh engine compiles
+    EXACTLY one program per bucket at warmup, steady-state traffic (full and
+    padded partial batches) adds zero compiles, and a hot-swap install is a
+    pointer flip — serving the new weights retraces nothing."""
+    from fedcrack_tpu.analysis.sanitizers import RecompileSentry
+    from fedcrack_tpu.configs import ModelConfig, ServeConfig
+    from fedcrack_tpu.serve import InferenceEngine
+    from fedcrack_tpu.serve.hot_swap import ModelVersionManager
+
+    _, var0, var1 = stack
+    engine = InferenceEngine(
+        ModelConfig(**TINY_KW),
+        ServeConfig(bucket_sizes=BUCKETS, max_batch=4, max_delay_ms=10.0,
+                    tile_overlap=4),
+    )
+    if not RecompileSentry.supported(engine._fn):
+        pytest.skip("jit wrapper exposes no _cache_size on this jax build")
+    sentry = RecompileSentry()
+    sentry.watch("serve.predict", engine._fn)
+    mgr = ModelVersionManager(engine, var0)
+    with sentry.expect(compiles=len(BUCKETS)):
+        engine.warmup(mgr.snapshot()[1])
+    sentry.mark()
+    for size in BUCKETS:
+        engine.predict_bucket(mgr.snapshot()[1], _images(4, size))
+        engine.predict_bucket(mgr.snapshot()[1], _images(2, size, seed=1))
+    sentry.assert_steady()
+    assert mgr.install(1, var1)
+    for size in BUCKETS:
+        out = engine.predict_bucket(mgr.snapshot()[1], _images(3, size, seed=2))
+        assert out.shape == (3, size, size, 1)
+    sentry.assert_steady()
+    assert sentry.deltas() == {"serve.predict": 0}
+
+
+def test_batcher_dispatch_no_implicit_transfers(stack):
+    """The staged discipline of the dispatch path, armed for real: with
+    jax.transfer_guard('disallow') active, a prepared snapshot serves whole
+    batches end to end — every host<->device move on the serving path is an
+    explicit device_put/device_get, so nothing can silently stall the
+    pipeline with an implicit transfer."""
+    import jax
+
+    from fedcrack_tpu.analysis.sanitizers import no_implicit_transfers
+    from fedcrack_tpu.serve.batcher import MicroBatcher, StaticWeights
+
+    engine, var0, _ = stack
+    dev0 = engine.prepare(var0)
+    engine.warmup(dev0)  # compile outside the guard
+    # The worker's inner dispatch op under a thread-local guard:
+    with no_implicit_transfers():
+        probs = engine.predict_bucket(dev0, _images(4, BUCKETS[0]))
+    assert probs.shape == (4, BUCKETS[0], BUCKETS[0], 1)
+    # Full batcher round-trip: the dispatch runs on worker THREADS, so the
+    # guard must be installed process-wide for the span.
+    jax.config.update("jax_transfer_guard", "disallow")
+    try:
+        with MicroBatcher(engine, StaticWeights(dev0, 0)) as batcher:
+            futs = [
+                batcher.submit(img)
+                for img in _images(8, BUCKETS[1], seed=3)
+            ]
+            results = [f.result(timeout=60) for f in futs]
+    finally:
+        jax.config.update("jax_transfer_guard", "allow")
+    assert len(results) == 8
+    assert all(r.model_version == 0 for r in results)
