@@ -12,6 +12,7 @@ from fedcrack_tpu.analysis.engine import Rule
 
 def all_rules() -> list[Rule]:
     from fedcrack_tpu.analysis.rules import (
+        compress,
         deadcode,
         determinism,
         durability,
@@ -21,7 +22,7 @@ def all_rules() -> list[Rule]:
     )
 
     out: list[Rule] = []
-    for pack in (determinism, durability, trace, transport, locks, deadcode):
+    for pack in (determinism, durability, trace, transport, compress, locks, deadcode):
         out.extend(cls() for cls in pack.RULES)
     return out
 

@@ -37,6 +37,7 @@ from fedcrack_tpu.chaos.plan import (
     CRASH_AFTER_UPLOAD,
     CRASH_BEFORE_UPLOAD,
     CRASH_DURING_UPLOAD,
+    CORRUPT_COMPRESSED_FRAME,
     CORRUPT_PAYLOAD,
     MESH_DEVICE_FAIL,
     MESH_NONFINITE,
@@ -88,6 +89,25 @@ def _round_of(msg) -> int | None:
 
 
 def _poison_weights(blob: bytes, mode: str) -> bytes:
+    if mode == CORRUPT_COMPRESSED_FRAME:
+        from fedcrack_tpu.compress import is_frame
+
+        if not is_frame(blob):
+            # A raw msgpack blob has no checksum: one flipped bit inside a
+            # float payload is valid msgpack, almost always finite, and
+            # would sail through shape/finiteness sanitation into FedAvg —
+            # a SILENT corruption, not the rejected one this fault kind
+            # asserts. On a null-codec cohort degrade to the structural
+            # mangle, which the server's decode gate deterministically
+            # rejects, keeping the fault's contract ("never averaged").
+            return _poison_weights(blob, CORRUPT_PAYLOAD)
+        # One flipped bit INSIDE the encoded frame body (past the magic +
+        # CRC header), the failure a lossy link actually delivers: the
+        # frame still LOOKS like a frame, so only the CRC check can catch
+        # it — which is exactly the claim under test.
+        pos = max(8, (3 * len(blob)) // 4)
+        pos = min(pos, len(blob) - 1)
+        return blob[:pos] + bytes([blob[pos] ^ 0x10]) + blob[pos + 1 :]
     if mode == TRUNCATE_PAYLOAD:
         return blob[: max(1, len(blob) // 2)]
     if mode == CORRUPT_PAYLOAD:
@@ -103,6 +123,44 @@ def _poison_weights(blob: bytes, mode: str) -> bytes:
         from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
         import jax
 
+        from fedcrack_tpu.compress import decode_frame, encode_frame, is_frame
+
+        if is_frame(blob):
+            # A compressed cohort: the blob is an FCWF frame, not msgpack —
+            # poison INSIDE the frame and re-frame it, so the wire carries
+            # a CRC-VALID frame whose reconstruction is non-finite. This is
+            # the fault's meaning under compression: the CRC must pass and
+            # the validate_update sanitation gate must be the thing that
+            # refuses it (the CRC-failure case is CORRUPT_COMPRESSED_FRAME).
+            frame = decode_frame(blob)
+            leaves = [dict(spec) for spec in frame.leaves]
+            payload = bytearray(frame.payload)
+            off, poisoned = 0, False
+            for spec in leaves:
+                shape = spec.get("shape") or []
+                n = 1
+                for s in shape:
+                    n *= int(s)
+                if spec.get("enc") == "int8":
+                    if not poisoned and spec.get("scales"):
+                        n_scales = len(spec["scales"]) // 4
+                        spec["scales"] = np.full(
+                            n_scales, np.inf, np.float32
+                        ).tobytes()
+                        poisoned = True
+                    off += n
+                else:  # topk: k int32 indices then k float32 values
+                    k = int(spec.get("k", 0))
+                    if not poisoned and k:
+                        payload[off + 4 * k : off + 8 * k] = np.full(
+                            k, np.nan, np.float32
+                        ).tobytes()
+                        poisoned = True
+                    off += 8 * k
+            return encode_frame(
+                frame.codec, frame.round, frame.base_version, leaves,
+                bytes(payload),
+            )
         tree = tree_from_bytes(blob)
         leaves, treedef = jax.tree_util.tree_flatten(tree)
         poisoned = []
@@ -145,7 +203,12 @@ class ClientChaos:
         fault = self.plan.take(STRAGGLER_DELAY, client=cname, round=rnd)
         if fault is not None:
             time.sleep(fault.delay_s)
-        for mode in (CORRUPT_PAYLOAD, TRUNCATE_PAYLOAD, NAN_UPDATE):
+        for mode in (
+            CORRUPT_PAYLOAD,
+            TRUNCATE_PAYLOAD,
+            NAN_UPDATE,
+            CORRUPT_COMPRESSED_FRAME,
+        ):
             if self.plan.take(mode, client=cname, round=rnd) is not None:
                 msg.done.weights = _poison_weights(msg.done.weights, mode)
         if self.plan.take(STALE_REPLAY, client=cname, round=rnd) is not None:

@@ -30,6 +30,11 @@ CORRUPT_PAYLOAD = "corrupt_payload"            # TrainDone weights bytes mangled
 TRUNCATE_PAYLOAD = "truncate_payload"          # TrainDone weights cut in half
 NAN_UPDATE = "nan_update"                      # TrainDone weights re-encoded with NaNs
 STALE_REPLAY = "stale_replay"                  # TrainDone re-tagged with round-1
+# Bit-flip INSIDE an encoded compressed frame (round 12): one payload bit
+# flips after the client framed + CRC'd its update — the server's frame
+# decode must reject it (checksum mismatch) before any reconstruction, and
+# the round must still reach quorum without the poisoned upload.
+CORRUPT_COMPRESSED_FRAME = "corrupt_compressed_frame"
 
 # Mesh plane (driver hook; fedcrack_tpu.parallel.driver fault_injector).
 MESH_DEVICE_FAIL = "mesh_device_fail"          # round dispatch raises (preemption)
@@ -51,6 +56,7 @@ CLIENT_KINDS = frozenset(
         TRUNCATE_PAYLOAD,
         NAN_UPDATE,
         STALE_REPLAY,
+        CORRUPT_COMPRESSED_FRAME,
     }
 )
 MESH_KINDS = frozenset({MESH_DEVICE_FAIL, MESH_NONFINITE})

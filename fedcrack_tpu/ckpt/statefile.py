@@ -66,6 +66,12 @@ def server_state_to_bytes(state: Any) -> bytes:
         "logs": dict(state.logs),
         "history": [dict(h) for h in state.history],
         "rejected": dict(state.rejected),
+        # Wire accounting for the in-flight round (round 12): sorted like
+        # `received` so the snapshot bytes stay a pure function of state.
+        "wire_bytes": {
+            name: int(n) for name, n in sorted(state.wire_bytes.items())
+        },
+        "codecs": {name: c for name, c in sorted(state.codecs.items())},
         "opt_state": opt_blob,
     }
     return msgpack.packb(payload, use_bin_type=True)
@@ -122,6 +128,12 @@ def server_state_from_bytes(blob: bytes, config: Any) -> Any:
         logs={k: bytes(v) for k, v in payload["logs"].items()},
         history=tuple(payload["history"]),
         rejected=dict(payload.get("rejected", {})),
+        # Absent in pre-round-12 snapshots: default to empty (the in-flight
+        # round's wire accounting then restarts, never its updates).
+        wire_bytes={
+            k: int(v) for k, v in payload.get("wire_bytes", {}).items()
+        },
+        codecs=dict(payload.get("codecs", {})),
         server_opt_state=opt_state,
         # Monotonic clocks do not survive a process: re-arm on first event
         # (rounds._advance_time stamps round_started_at when RUNNING).

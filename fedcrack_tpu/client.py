@@ -87,6 +87,13 @@ def main(argv: list[str] | None = None) -> int:
     )
     p.add_argument("--tls-cert", dest="tls_cert", help="client certificate for mTLS (PEM)")
     p.add_argument("--tls-key", dest="tls_key", help="client private key for mTLS (PEM)")
+    p.add_argument(
+        "--max-message-mb",
+        type=int,
+        dest="max_message_mb",
+        help="gRPC send/receive cap in MiB (must cover the server's dense "
+        "weight broadcast regardless of the negotiated upload codec)",
+    )
     args = p.parse_args(argv)
 
     # Flags merge into the RAW config dict before FedConfig construction, so
@@ -113,6 +120,7 @@ def main(argv: list[str] | None = None) -> int:
             ("tls_ca", args.tls_ca),
             ("tls_cert", args.tls_cert),
             ("tls_key", args.tls_key),
+            ("max_message_mb", args.max_message_mb),
         ]
         if v is not None
     }

@@ -1,0 +1,52 @@
+"""Compressed update transport: quantized + top-k sparsified deltas with
+error feedback, on a versioned CRC-checked wire frame.
+
+- :mod:`codecs` — the client-side encoders (NullCodec bit-exact escape
+  hatch, Int8Codec delta quantization, TopKDeltaCodec + error feedback).
+- :mod:`frames` — the wire frame and the server-side decode that feeds
+  ``fed.serialization.validate_update`` (fedlint COMP001 keeps it there).
+- :mod:`mesh` — the on-device encode∘decode twins for the mesh plane's
+  zero-host-cost trajectory A/B.
+"""
+
+from fedcrack_tpu.compress.codecs import (
+    CODEC_INT8,
+    CODEC_NAMES,
+    CODEC_NULL,
+    CODEC_TOPK,
+    Codec,
+    DEFAULT_TOPK_FRACTION,
+    Int8Codec,
+    NullCodec,
+    TopKDeltaCodec,
+    encoded_bytes_model,
+    get_codec,
+)
+from fedcrack_tpu.compress.frames import (
+    FRAME_OVERHEAD_BYTES,
+    Frame,
+    decode_frame,
+    decode_update,
+    encode_frame,
+    is_frame,
+)
+
+__all__ = [
+    "CODEC_INT8",
+    "CODEC_NAMES",
+    "CODEC_NULL",
+    "CODEC_TOPK",
+    "Codec",
+    "DEFAULT_TOPK_FRACTION",
+    "FRAME_OVERHEAD_BYTES",
+    "Frame",
+    "Int8Codec",
+    "NullCodec",
+    "TopKDeltaCodec",
+    "decode_frame",
+    "decode_update",
+    "encode_frame",
+    "encoded_bytes_model",
+    "get_codec",
+    "is_frame",
+]

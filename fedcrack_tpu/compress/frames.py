@@ -1,0 +1,340 @@
+"""The compressed-update wire frame: versioned, CRC-checked, self-describing.
+
+Layout (all little-endian)::
+
+    MAGIC "FCWF" (4) | crc32c of body (4, LE uint32) | body
+
+where ``body`` is one msgpack map::
+
+    {"v": 1, "codec": str, "round": int, "base_version": int,
+     "leaves": [{"shape": [...], "enc": "int8"|"topk", ...}, ...],
+     "zlib": bool, "payload": bytes}
+
+``payload`` is the per-leaf codes concatenated in leaf order (int8: ``n``
+quantized bytes; topk: ``k`` int32 indices then ``k`` float32 values),
+zlib-compressed when ``zlib`` is true. The CRC covers the whole body, so a
+single flipped bit anywhere in a frame — header, manifest, or payload — is
+detected BEFORE any reconstruction happens (the chaos suite's
+CORRUPT_COMPRESSED_FRAME fault pins this).
+
+``base_version`` is the server model_version of the round-base weights the
+delta was computed against; the server refuses a frame whose base does not
+match its current version, so a delta can never be applied to the wrong
+base (the "unambiguous delta decode" contract from the round template).
+
+The magic bytes cannot collide with a raw update: a legitimate msgpack
+weight pytree starts with a map marker (0x8x / 0xde / 0xdf), never ASCII
+"F" — so :func:`is_frame` is an exact discriminator on this wire.
+
+Every decode that feeds FedAvg must route its reconstruction through
+``fed.serialization.validate_update`` (fedlint rule COMP001 enforces this
+statically over ``compress/`` and ``fed/``): the frame CRC proves the bytes
+are the bytes the client sent, while validate_update proves the
+reconstructed tree is safe to average — a poisoned client can produce a
+perfectly CRC-valid NaN frame.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+import msgpack
+import numpy as np
+
+MAGIC = b"FCWF"
+FRAME_VERSION = 1
+
+# Manifest + header bytes a frame adds over its raw payload; conservative
+# (measured frames sit well under this for any real leaf count).
+FRAME_OVERHEAD_BYTES = 4096
+
+
+def is_frame(blob: bytes) -> bool:
+    return len(blob) >= 8 and blob[:4] == MAGIC
+
+
+def expand_scales(scales: np.ndarray, bucket: int, n: int) -> np.ndarray:
+    """Per-entry float32 scale vector from per-bucket scales — THE int8
+    scale-expansion rule. Shared by the codec-side dequantizer
+    (``codecs.int8_dequantize``) and the frame reconstruction below, so
+    the two sides of the wire can never silently diverge. Index-gather,
+    not ``np.repeat(scales, bucket)``: the allocation is O(n) regardless
+    of ``bucket``, so a manifest declaring an absurd bucket cannot force
+    a bucket-sized allocation (the scales-count check still pins
+    ``scales.size == ceil(n/bucket)``)."""
+    return scales.astype(np.float32, copy=False)[np.arange(n) // int(bucket)]
+
+
+@dataclass(frozen=True)
+class Frame:
+    codec: str
+    round: int
+    base_version: int
+    leaves: tuple[dict, ...]
+    payload: bytes
+
+
+def encode_frame(
+    codec: str,
+    round: int,
+    base_version: int,
+    leaves: Sequence[dict],
+    payload: bytes,
+    *,
+    compress: bool = True,
+) -> bytes:
+    """Wrap per-leaf codes into one CRC-checked wire frame. ``compress``
+    zlib-deflates the payload (level 1 — the entropy win on near-zero int8
+    codes saturates early; higher levels only cost encode time)."""
+    from fedcrack_tpu.native import crc32c
+
+    body_payload = zlib.compress(payload, 1) if compress else payload
+    body = msgpack.packb(
+        {
+            "v": FRAME_VERSION,
+            "codec": codec,
+            "round": int(round),
+            "base_version": int(base_version),
+            "leaves": list(leaves),
+            "zlib": bool(compress),
+            "payload": body_payload,
+        },
+        use_bin_type=True,
+    )
+    return MAGIC + struct.pack("<I", crc32c(body)) + body
+
+
+def _manifest_payload_bytes(leaves: Sequence[dict]) -> int:
+    """Payload bytes the manifest CLAIMS to carry (int8: n codes/leaf;
+    topk: 8k/leaf) — the inflate bound below."""
+    total = 0
+    for i, spec in enumerate(leaves):
+        try:
+            n = 1
+            for s in spec["shape"]:
+                n *= int(s)
+            enc = spec["enc"]
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"malformed manifest entry {i} ({e})") from e
+        if enc == "int8":
+            total += n
+        elif enc == "topk":
+            total += 8 * int(spec.get("k", 0))
+        else:
+            raise ValueError(f"leaf {i} has unknown encoding {enc!r}")
+    return total
+
+
+def decode_frame(blob: bytes, *, max_decoded_bytes: int | None = None) -> Frame:
+    """Parse + integrity-check a frame. Raises ``ValueError`` with the
+    rejection reason (bad magic / CRC mismatch / unknown version /
+    malformed manifest) — the server logs the reason to the round's
+    ``rejected`` history map.
+
+    ``max_decoded_bytes`` (the server path passes a template-derived
+    bound via :func:`decode_update`) arms decompression-bomb protection:
+    the manifest's implied payload size must fit the bound, and the zlib
+    inflate is hard-capped at that implied size — a frame whose payload
+    inflates past its own manifest is a ValueError, never a giant
+    allocation escaping the caller's rejection handling as MemoryError."""
+    from fedcrack_tpu.native import crc32c
+
+    if not is_frame(blob):
+        raise ValueError("not a compressed-update frame (bad magic)")
+    declared = struct.unpack("<I", blob[4:8])[0]
+    body = blob[8:]
+    got = crc32c(body)
+    if got != declared:
+        raise ValueError(
+            f"frame checksum mismatch: computed {got:#010x}, "
+            f"declared {declared:#010x}"
+        )
+    try:
+        head = msgpack.unpackb(body, raw=False)
+    except Exception as e:
+        raise ValueError(f"undecodable frame body ({type(e).__name__})") from e
+    if not isinstance(head, dict) or head.get("v") != FRAME_VERSION:
+        raise ValueError(
+            f"unknown frame version {head.get('v') if isinstance(head, dict) else None!r}"
+        )
+    leaves = head.get("leaves")
+    payload = head.get("payload")
+    if not isinstance(leaves, list) or not isinstance(payload, (bytes, bytearray)):
+        raise ValueError("malformed frame: missing leaves manifest or payload")
+    payload = bytes(payload)
+    if head.get("zlib"):
+        if max_decoded_bytes is not None:
+            implied = _manifest_payload_bytes(leaves)
+            if implied > max_decoded_bytes:
+                raise ValueError(
+                    f"frame manifest implies {implied} payload bytes, "
+                    f"caller bound is {max_decoded_bytes}"
+                )
+            try:
+                payload = zlib.decompressobj().decompress(payload, implied + 1)
+            except zlib.error as e:
+                raise ValueError(f"frame payload inflate failed ({e})") from e
+            if len(payload) > implied:
+                raise ValueError(
+                    "frame payload inflates past its own manifest "
+                    f"({implied} bytes declared)"
+                )
+        else:
+            try:
+                payload = zlib.decompress(payload)
+            except zlib.error as e:
+                raise ValueError(f"frame payload inflate failed ({e})") from e
+    try:
+        # A CRC-valid body can still carry junk-typed fields (round=None,
+        # non-dict manifest entries): every coercion failure must surface
+        # as ValueError — the only family the server's rejection path
+        # catches — never TypeError aborting the RPC stream.
+        return Frame(
+            codec=str(head.get("codec", "")),
+            round=int(head.get("round", 0)),
+            base_version=int(head.get("base_version", 0)),
+            leaves=tuple(dict(l) for l in leaves),
+            payload=payload,
+        )
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"malformed frame fields ({e})") from e
+
+
+def _reconstruct_deltas(frame: Frame) -> list[np.ndarray]:
+    """Per-leaf float32 delta arrays from the frame's manifest + payload,
+    with explicit size accounting (a manifest lying about shapes/k fails
+    here as a ValueError, never as a silent mis-slice)."""
+    out: list[np.ndarray] = []
+    off = 0
+    buf = frame.payload
+    for i, spec in enumerate(frame.leaves):
+        try:
+            shape = tuple(int(s) for s in spec["shape"])
+            enc = spec["enc"]
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"malformed manifest entry {i} ({e})") from e
+        n = int(np.prod(shape)) if shape else 1
+        if enc == "int8":
+            bucket = int(spec.get("bucket", 0))
+            scales_raw = spec.get("scales", b"")
+            if bucket < 1 or not isinstance(scales_raw, (bytes, bytearray)):
+                raise ValueError(f"leaf {i} int8 manifest missing bucket/scales")
+            scales = np.frombuffer(bytes(scales_raw), np.float32)
+            if scales.size != max(1, -(-n // bucket)):
+                raise ValueError(
+                    f"leaf {i} carries {scales.size} scales for "
+                    f"{n} entries at bucket {bucket}"
+                )
+            end = off + n
+            if end > len(buf):
+                raise ValueError(f"frame payload truncated at leaf {i}")
+            q = np.frombuffer(buf, np.int8, count=n, offset=off)
+            off = end
+            per_entry = expand_scales(scales, bucket, n)
+            out.append((q.astype(np.float32) * per_entry).reshape(shape))
+        elif enc == "topk":
+            k = int(spec.get("k", 0))
+            if k < 0 or k > n:
+                raise ValueError(f"leaf {i} declares k={k} outside [0, {n}]")
+            end = off + 8 * k
+            if end > len(buf):
+                raise ValueError(f"frame payload truncated at leaf {i}")
+            idx = np.frombuffer(buf, np.int32, count=k, offset=off)
+            vals = np.frombuffer(buf, np.float32, count=k, offset=off + 4 * k)
+            off = end
+            if k and (idx.min() < 0 or idx.max() >= n):
+                raise ValueError(
+                    f"leaf {i} sparse index out of range for {n} entries"
+                )
+            dense = np.zeros(n, np.float32)
+            dense[idx] = vals
+            out.append(dense.reshape(shape))
+        else:
+            raise ValueError(f"leaf {i} has unknown encoding {enc!r}")
+    if off != len(buf):
+        raise ValueError(
+            f"frame payload has {len(buf) - off} trailing bytes past the manifest"
+        )
+    return out
+
+
+def decode_update(
+    blob: bytes,
+    template: Any,
+    base: Any,
+    *,
+    expected_base_version: int | None = None,
+    expected_round: int | None = None,
+) -> tuple[Any, Frame]:
+    """Server-side decode of a framed update into a full weight pytree.
+
+    ``template`` fixes structure/dtypes (the server's float32 decode
+    template), ``base`` is the round-base global pytree the delta applies
+    to. ``expected_base_version`` pins the delta to the server's current
+    model_version — a frame built against any other base is REJECTED
+    (stale-base), because applying it would reconstruct garbage weights
+    that still pass every shape check.
+
+    Raises ``ValueError`` on any integrity/consistency failure; the caller
+    (``fed.rounds``) turns that into a REJECTED + history-logged update and
+    must pass the reconstruction through
+    ``fed.serialization.validate_update`` before FedAvg (COMP001).
+    """
+    import jax
+
+    flat_template, treedef = jax.tree_util.tree_flatten(template)
+    # Decompression bound from the TEMPLATE, not the manifest: the largest
+    # honest payload is 8 bytes/entry (topk), so any frame claiming more
+    # is rejected before a single byte inflates.
+    total_entries = sum(
+        int(np.prod(np.shape(t))) if np.shape(t) else 1 for t in flat_template
+    )
+    frame = decode_frame(blob, max_decoded_bytes=8 * total_entries + 1024)
+    if expected_base_version is not None and frame.base_version != expected_base_version:
+        raise ValueError(
+            f"stale round base: frame delta is against model_version "
+            f"{frame.base_version}, server is at {expected_base_version}"
+        )
+    if expected_round is not None and frame.round != expected_round:
+        raise ValueError(
+            f"frame round {frame.round} does not match message round "
+            f"{expected_round}"
+        )
+    flat_base = jax.tree_util.tree_leaves(base)
+    if len(flat_base) != len(flat_template):
+        raise ValueError(
+            f"base has {len(flat_base)} leaves, template expects "
+            f"{len(flat_template)}"
+        )
+    if len(frame.leaves) != len(flat_template):
+        raise ValueError(
+            f"frame carries {len(frame.leaves)} leaves, template expects "
+            f"{len(flat_template)}"
+        )
+    # Manifest shapes are pinned to the template BEFORE reconstruction: the
+    # declared shape sizes every allocation below, so a lying manifest
+    # (e.g. shape [10**12] with k=0, which no payload-size check would
+    # bound) must fail here as a ValueError — never as a giant allocation
+    # escaping the caller's rejection handling as a MemoryError.
+    for i, (spec, t) in enumerate(zip(frame.leaves, flat_template)):
+        try:
+            declared = tuple(int(s) for s in spec["shape"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"malformed manifest entry {i} ({e})") from e
+        t_shape = tuple(np.shape(t))
+        if declared != t_shape:
+            raise ValueError(
+                f"leaf {i} shape mismatch: frame {declared}, template "
+                f"{t_shape}"
+            )
+    deltas = _reconstruct_deltas(frame)
+    leaves = []
+    for d, b, t in zip(deltas, flat_base, flat_template):
+        t_arr = np.asarray(t)
+        leaves.append(
+            (np.asarray(b, np.float32) + d).astype(t_arr.dtype).reshape(t_arr.shape)
+        )
+    return jax.tree_util.tree_unflatten(treedef, leaves), frame

@@ -1,0 +1,104 @@
+"""On-device encode∘decode twins for the mesh plane.
+
+The gRPC plane compresses real wire bytes (``codecs``/``frames``); the mesh
+plane has no wire — every "client" lives on a chip and FedAvg is an ICI
+psum. What compression changes there is the TRAJECTORY: quantization error
+and sparsification delay perturb each client's contribution before the
+average. These twins apply the identical encode-then-decode value map to
+the per-client round delta ON DEVICE, inside the round program, so
+``run_mesh_federation`` can A/B trajectory quality (crack-IoU vs the
+NullCodec oracle) at zero host cost — no bytes ever leave HBM.
+
+Value-map parity with the host codecs (same scale rule, same keep rule):
+
+- int8: QSGD bucketed symmetric quantization — per-bucket scale
+  ``||bucket||_2 / 127`` (identical to :func:`codecs.qsgd_scales`) with
+  stochastic rounding ``floor(x/scale + u)``. The uniform draws come from
+  the JAX PRNG (per call / per client / per leaf fold-ins) rather than the
+  host codec's numpy generator, so int8 parity is distributional
+  (unbiased, same scales, same error bound), not bitwise.
+- topk_delta: per-leaf top-k by magnitude of (delta + error-feedback
+  residual), untransmitted mass carried to the next round. ``lax.top_k``
+  breaks magnitude ties by lowest index, same as the host codec's stable
+  argsort — bitwise the same keep set.
+
+The twins run inside ``shard_map`` blocks where each leaf is ONE client's
+(per-shard) value — :func:`fedcrack_tpu.parallel.fedavg_mesh._build_round`
+threads the error-feedback state through the program as a
+``P('clients')``-sharded pytree, so the accumulator never leaves device.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from fedcrack_tpu.compress.codecs import CODEC_NAMES, QSGD_BUCKET, leaf_k
+
+MESH_CODECS = CODEC_NAMES  # same registry, same names
+
+
+def int8_roundtrip(tree: Any, key, bucket: int = QSGD_BUCKET) -> Any:
+    """QSGD bucketed int8 quantize-dequantize (the Int8Codec value map):
+    per-bucket norm scale, stochastic rounding from ``key`` (folded per
+    leaf). Float32 math; codes never exceed |127| because
+    ``|x| <= ||bucket||_2``."""
+
+    def leaf(i, x):
+        x32 = x.astype(jnp.float32)
+        flat = x32.ravel()
+        n = flat.size
+        n_buckets = max(1, -(-n // bucket))
+        padded = jnp.pad(flat, (0, n_buckets * bucket - n))
+        segs = padded.reshape(n_buckets, bucket)
+        norms = jnp.sqrt(jnp.sum(segs * segs, axis=1))
+        scales = jnp.where(norms > 0.0, norms / 127.0, 1.0)
+        u = jax.random.uniform(jax.random.fold_in(key, i), segs.shape)
+        q = jnp.clip(jnp.floor(segs / scales[:, None] + u), -127.0, 127.0)
+        deq = (q * scales[:, None]).reshape(-1)[:n]
+        return deq.reshape(x.shape).astype(x.dtype)
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return jax.tree_util.tree_unflatten(
+        treedef, [leaf(i, x) for i, x in enumerate(leaves)]
+    )
+
+
+def topk_roundtrip(tree: Any, residual: Any, fraction: float) -> tuple[Any, Any]:
+    """Per-leaf top-k keep of (delta + residual); returns (kept, new
+    residual). ``k`` is static per leaf (``ceil(fraction * n)``, floored at
+    1), so the program shape is round-independent."""
+
+    def leaf(x, r):
+        x32 = x.astype(jnp.float32)
+        eff = (x32 + r.astype(jnp.float32)).ravel()
+        k = leaf_k(eff.size, fraction)
+        _, idx = lax.top_k(jnp.abs(eff), k)
+        kept = jnp.zeros_like(eff).at[idx].set(eff[idx])
+        new_r = eff - kept
+        return kept.reshape(x.shape).astype(x.dtype), new_r.reshape(x.shape)
+
+    flat_x, treedef = jax.tree_util.tree_flatten(tree)
+    flat_r = jax.tree_util.tree_leaves(residual)
+    pairs = [leaf(x, r) for x, r in zip(flat_x, flat_r)]
+    kept = jax.tree_util.tree_unflatten(treedef, [p[0] for p in pairs])
+    new_res = jax.tree_util.tree_unflatten(treedef, [p[1] for p in pairs])
+    return kept, new_res
+
+
+def zero_residual_like(tree: Any) -> Any:
+    """A float32 zero accumulator matching ``tree`` — the error-feedback
+    state's round-0 value."""
+    return jax.tree_util.tree_map(
+        lambda x: jnp.zeros(jnp.shape(x), jnp.float32), tree
+    )
+
+
+def validate_mesh_codec(codec: str | None) -> str:
+    name = codec or "null"
+    if name not in MESH_CODECS:
+        raise ValueError(f"unknown update codec {name!r}; known: {MESH_CODECS}")
+    return name

@@ -243,6 +243,21 @@ class FedConfig:
     # upload + broadcast bytes (server math stays float32; the reference
     # shipped full float32 pickles, fl_client.py:63).
     wire_dtype: str = "float32"
+    # Compressed update transport (round 12, fedcrack_tpu/compress): how
+    # each client's upload is encoded. "null" ships today's msgpack bytes
+    # unchanged (the bit-exactness escape hatch, test-pinned); "int8" ships
+    # the per-leaf symmetric int8-quantized round delta with f32 scale
+    # sidecars; "topk_delta" ships the top-k sparsified delta with a
+    # client-side error-feedback accumulator (dropped mass re-enters next
+    # round). Negotiated in-band at enroll like every other hyperparameter;
+    # legacy clients that ignore it keep sending raw blobs, which the
+    # server still accepts (mixed-codec cohorts decode to full trees before
+    # FedAvg, so they aggregate correctly).
+    update_codec: str = "null"
+    # TopKDeltaCodec keep fraction: each leaf transmits ceil(fraction * n)
+    # entries per round (8 bytes each vs 4 per dense f32 — 0.01 is ~50x
+    # fewer bytes before framing/zlib).
+    topk_fraction: float = 0.01
     host: str = "127.0.0.1"
     port: int = 8889              # reference: fl_server.py:218
     # Orbax checkpoint directory; empty disables. When the directory already
@@ -362,6 +377,19 @@ class FedConfig:
         if self.wire_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"wire_dtype must be float32 or bfloat16, got {self.wire_dtype!r}"
+            )
+        if self.update_codec not in ("null", "int8", "topk_delta"):
+            raise ValueError(
+                "update_codec must be 'null', 'int8' or 'topk_delta', got "
+                f"{self.update_codec!r}"
+            )
+        if not 0.0 < self.topk_fraction <= 1.0:
+            raise ValueError(
+                f"topk_fraction must be in (0, 1], got {self.topk_fraction}"
+            )
+        if self.max_message_mb < 1:
+            raise ValueError(
+                f"max_message_mb must be >= 1, got {self.max_message_mb}"
             )
         if bool(self.tls_cert) != bool(self.tls_key):
             # Half a TLS identity must fail fast — otherwise the server

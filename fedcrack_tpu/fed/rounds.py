@@ -49,6 +49,15 @@ Deliberate fixes over observed reference behavior (SURVEY.md §2.2):
     cohort/phase/received survive a server kill, so a restart resumes the
     SAME round; restored monotonic timestamps are discarded and the
     deadline re-arms from the first post-restart event.
+11. Compressed update transport (round 12, ``fedcrack_tpu.compress``): the
+    server advertises ``update_codec`` in-band; a framed upload is
+    CRC-checked, base-version-pinned, reconstructed against the current
+    global, and passed through the SAME ``validate_update`` gate as raw
+    bytes — corrupt/stale/NaN frames are REJECTED and history-logged, and
+    ``history[*]["bytes_received"]`` counts wire bytes (the frame), with
+    ``decoded_bytes_received``/``codecs`` alongside. Mixed cohorts (raw +
+    framed) aggregate correctly because everything decodes to a full tree
+    before FedAvg.
 """
 
 from __future__ import annotations
@@ -60,6 +69,7 @@ from typing import Any, Mapping
 
 import jax
 
+from fedcrack_tpu.compress import frames as wire_frames
 from fedcrack_tpu.configs import FedConfig
 from fedcrack_tpu.fed.algorithms import (
     apply_server_opt,
@@ -200,6 +210,12 @@ class ServerState:
     # Folded into the round's history entry at aggregation — rejected
     # updates are observable forever but averaged never.
     rejected: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    # Compressed-transport accounting for THIS round (round 12): per client,
+    # the bytes that actually crossed the wire (the encoded frame — the
+    # stored `received` blob is the DECODED reconstruction) and which codec
+    # produced them. Folded into the history entry at aggregation.
+    wire_bytes: Mapping[str, int] = dataclasses.field(default_factory=dict)
+    codecs: Mapping[str, str] = dataclasses.field(default_factory=dict)
 
     @property
     def broadcast_blob(self) -> bytes:
@@ -207,6 +223,34 @@ class ServerState:
 
     def _replace(self, **kw) -> "ServerState":
         return dataclasses.replace(self, **kw)
+
+
+# One-entry memo for the decoded round base: every framed upload applies
+# its delta to the broadcast tree, and at cohort scale decoding the full
+# model once PER UPLOAD inside the single-writer transition would become
+# the round's dominant serialized host cost. Keyed on the broadcast BYTES
+# themselves (identity fast-path, equality fallback — both cheaper than a
+# decode), never on hash(): a 64-bit hash collision between two servers'
+# blobs sharing this process-wide memo would decode a delta against the
+# WRONG base — finite, shape-correct, silently wrong, exactly the failure
+# class the base_version pin exists to kill. `transition` is single-writer
+# per server; concurrent servers in one process at worst thrash the entry
+# and re-decode (correctness is carried by the key).
+_ROUND_BASE_MEMO: dict = {}
+
+
+def _decoded_round_base(state: "ServerState"):
+    blob = state.broadcast_blob
+    hit = _ROUND_BASE_MEMO.get("base")
+    if (
+        hit is not None
+        and hit[0] == state.model_version
+        and (hit[1] is blob or hit[1] == blob)
+    ):
+        return hit[2]
+    tree = tree_from_bytes(blob, template=state.template)
+    _ROUND_BASE_MEMO["base"] = (state.model_version, blob, tree)
+    return tree
 
 
 def drop_log(state: ServerState, cname: str, title: str) -> ServerState:
@@ -255,6 +299,12 @@ def _ready_config(state: ServerState, status: str) -> dict[str, Any]:
         "fedprox_mu": state.config.fedprox_mu,
         "pos_weight": state.config.pos_weight,
         "wire_dtype": state.config.wire_dtype,
+        # Compressed update transport (round 12): the codec the server asks
+        # the cohort to upload with; the round base for delta codecs is the
+        # broadcast this handshake's model_version names. Legacy clients
+        # that ignore the key keep sending raw blobs — always accepted.
+        "update_codec": state.config.update_codec,
+        "topk_fraction": state.config.topk_fraction,
     }
 
 
@@ -382,10 +432,19 @@ def _aggregate(state: ServerState, now: float) -> ServerState:
         "completed_at": now,
         # Observability (SURVEY.md §5.5): round wall-clock + control-plane
         # bytes (client uploads in, one broadcast-sized blob out per client).
+        # "bytes_received" is the bytes that crossed the WIRE — for a framed
+        # (compressed) upload that is the encoded frame, not the decoded
+        # reconstruction stored in `received`; "decoded_bytes_received" is
+        # the post-decode size, so received/decoded is the round's measured
+        # upload compression ratio.
         "wall_clock_s": (
             now - state.round_started_at if state.round_started_at is not None else None
         ),
-        "bytes_received": sum(len(state.received[n][0]) for n in names),
+        "bytes_received": sum(
+            state.wire_bytes.get(n, len(state.received[n][0])) for n in names
+        ),
+        "decoded_bytes_received": sum(len(state.received[n][0]) for n in names),
+        "codecs": {n: state.codecs.get(n, "null") for n in names},
         "bytes_broadcast": len(new_wire_blob or new_blob),
         # Quorum observability: how many updates closed the round out of how
         # large a cohort, plus every update refused this round and why.
@@ -400,6 +459,8 @@ def _aggregate(state: ServerState, now: float) -> ServerState:
         model_version=state.model_version + 1,
         received={},
         rejected={},
+        wire_bytes={},
+        codecs={},
         round_started_at=now,
         phase=PHASE_FINISHED if finished else PHASE_RUNNING,
         history=state.history + (entry,),
@@ -431,7 +492,15 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
                     if cname in state.received:
                         received = dict(state.received)
                         del received[cname]
-                        state = state._replace(received=received)
+                        wire = {
+                            k: v for k, v in state.wire_bytes.items() if k != cname
+                        }
+                        codecs = {
+                            k: v for k, v in state.codecs.items() if k != cname
+                        }
+                        state = state._replace(
+                            received=received, wire_bytes=wire, codecs=codecs
+                        )
                     return state, Reply(status=SW, config=_ready_config(state, SW))
                 if cname in state.departed:
                     # Dropped by a deadline shrink, now back: re-admit. Fix
@@ -556,7 +625,52 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
                         "server_round": state.current_round,
                     },
                 )
-            if state.config.sanitize_updates:
+            wire_len = len(blob)
+            codec_name = "null"
+            problem = None
+            if wire_frames.is_frame(blob):
+                # Compressed-update frame (round 12): CRC-check, reconstruct
+                # the full weight tree against the server's CURRENT round
+                # base (the frame's base_version must match — a delta
+                # against any other base would reconstruct garbage weights
+                # that still pass every shape check), then route the
+                # reconstruction through the SAME validate_update sanitation
+                # gate raw uploads take. Frames are always sanitized
+                # regardless of config.sanitize_updates: corrupt compressed
+                # bytes are exactly the new failure surface this subsystem
+                # introduces, and a CRC-valid frame can still carry NaNs
+                # from a poisoned trainer (fedlint COMP001 pins this decode
+                # path to validate_update statically).
+                if state.template is None:
+                    problem = "compressed frame rejected: server has no decode template"
+                else:
+                    try:
+                        # The delta base is the BROADCAST blob — the bytes
+                        # the client actually pulled and subtracted. With
+                        # wire_dtype=bfloat16 that is the bf16-cast wire
+                        # blob, NOT global_blob: decoding against the f32
+                        # global would add (f32_base - bf16(f32_base)) to
+                        # every reconstructed weight — finite, shape-
+                        # correct, silently wrong.
+                        tree, frame = wire_frames.decode_update(
+                            blob,
+                            template=state.template,
+                            base=_decoded_round_base(state),
+                            expected_base_version=state.model_version,
+                        )
+                    except ValueError as e:
+                        problem = f"compressed frame rejected: {e}"
+                    else:
+                        codec_name = frame.codec
+                        # Validate the materialized tree directly (no
+                        # redundant encode∘decode round-trip per upload);
+                        # serialize once, for storage, only on accept.
+                        problem = validate_update(tree, state.template)
+                        if problem is None:
+                            blob = tree_to_bytes(tree)
+                if problem is None and ns < 0:
+                    problem = f"negative sample count {ns}"
+            elif state.config.sanitize_updates:
                 # Deliberate cost note: this decodes the payload once at
                 # receive and _aggregate decodes it again at the barrier —
                 # both inside the single-writer transition, like every other
@@ -565,30 +679,36 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
                 # weight blobs are small whenever the TPU data plane carries
                 # the real traffic; an operator who needs multi-GB uploads
                 # sanitized off-thread should gate at the transport instead.
-                problem = None
                 if ns < 0:
                     problem = f"negative sample count {ns}"
                 elif state.template is not None:
                     problem = validate_update(blob, state.template)
-                if problem is not None:
-                    # Refused BEFORE it can touch FedAvg; observable in the
-                    # round's history entry. The client fails loudly — a
-                    # poisoned trainer must not silently keep federating.
-                    rejected = dict(state.rejected)
-                    rejected[cname] = problem
-                    state = state._replace(rejected=rejected)
-                    return state, Reply(
-                        status=REJECTED,
-                        config={
-                            "reason": f"update rejected: {problem}",
-                            "client_round": rnd,
-                        },
-                    )
+            if problem is not None:
+                # Refused BEFORE it can touch FedAvg; observable in the
+                # round's history entry. The client fails loudly — a
+                # poisoned trainer must not silently keep federating.
+                rejected = dict(state.rejected)
+                rejected[cname] = problem
+                state = state._replace(rejected=rejected)
+                return state, Reply(
+                    status=REJECTED,
+                    config={
+                        "reason": f"update rejected: {problem}",
+                        "client_round": rnd,
+                    },
+                )
             # NB: updates arriving while enrollment is still open are buffered
             # but never trigger aggregation — the cohort isn't final yet.
+            # `received` holds the DECODED blob (a framed upload was
+            # reconstructed above); `wire_bytes`/`codecs` remember what
+            # actually crossed the wire for the round's history accounting.
             received = dict(state.received)
             received[cname] = (blob, ns)
-            state = state._replace(received=received)
+            wire = dict(state.wire_bytes)
+            wire[cname] = wire_len
+            codecs = dict(state.codecs)
+            codecs[cname] = codec_name
+            state = state._replace(received=received, wire_bytes=wire, codecs=codecs)
             if _barrier_met(state):
                 state = _aggregate(state, now)
                 status = FIN if state.phase == PHASE_FINISHED else RESP_ARY

@@ -31,7 +31,7 @@ def tree_to_bytes(tree: Any, cast_dtype: str | None = None) -> bytes:
     return serialization.msgpack_serialize(host)
 
 
-def validate_update(blob: bytes, template: Any) -> str | None:
+def validate_update(blob: Any, template: Any) -> str | None:
     """Sanitation gate for an untrusted client update: the reason the blob
     must NOT enter FedAvg, or None when it is clean.
 
@@ -42,11 +42,18 @@ def validate_update(blob: bytes, template: Any) -> str | None:
     numeric leaf is fully finite (one NaN client otherwise propagates into
     the global average and from there to every client). Wire-dtype casts
     (bfloat16 uploads) pass untouched — shape, not dtype, is the contract.
+
+    ``blob`` may also be an already-materialized pytree (the compressed-
+    frame path validates its reconstruction directly, skipping a redundant
+    encode∘decode round-trip per upload); bytes take the wire decode first.
     """
-    try:
-        raw = serialization.msgpack_restore(blob)
-    except Exception as e:  # msgpack raises several exception families
-        return f"undecodable payload ({type(e).__name__})"
+    if isinstance(blob, (bytes, bytearray)):
+        try:
+            raw = serialization.msgpack_restore(bytes(blob))
+        except Exception as e:  # msgpack raises several exception families
+            return f"undecodable payload ({type(e).__name__})"
+    else:
+        raw = blob
     flat_raw = jax.tree_util.tree_leaves(raw)
     flat_template = jax.tree_util.tree_leaves(template)
     if len(flat_raw) != len(flat_template):

@@ -142,6 +142,14 @@ class RoundRecord:
     # which also carries the one-time pool transfer). A federation asked to
     # run resident but bounced by the HBM guard records "streamed".
     data_placement: str = "streamed"
+    # Compressed-transport counter (round 12): what this round's client
+    # uploads would cost on the wire under the round program's update
+    # codec — active clients x the round_fn's priced wire_bytes_per_client
+    # (compress.codecs.encoded_bytes_model; the mesh plane moves no real
+    # wire bytes, so this is the analytic twin of the gRPC plane's
+    # history["bytes_received"]). None for round programs without the
+    # counter (spatial rounds, externally built callables).
+    bytes_per_round: int | None = None
 
 
 class NonFiniteRound(RuntimeError):
@@ -700,6 +708,17 @@ def run_mesh_federation(
         # round from identical state. Host device_get round-trips float32
         # exactly, so the replayed trajectory is bit-identical (test-pinned).
         snapshot = jax.device_get(variables) if max_round_retries > 0 else None
+        # Codec-twin cross-round state rides the same contract (r12 review
+        # fix): the round program commits its error-feedback pytree / int8
+        # seed counter when the async dispatch returns — before a
+        # non-finite output surfaces at the host fetch — so a retry must
+        # roll it back too, or the topk twin banks mass from the discarded
+        # attempt. Pointer-level snapshot (immutable jax arrays + an int).
+        codec_snapshot = (
+            round_fn.codec_state()
+            if max_round_retries > 0 and hasattr(round_fn, "codec_state")
+            else None
+        )
         attempt = 0
         round_faults: list[str] = []
         while True:
@@ -865,6 +884,8 @@ def run_mesh_federation(
                     except Exception:
                         restored = None
                 variables = restored if restored is not None else snapshot
+                if codec_snapshot is not None:
+                    round_fn.set_codec_state(codec_snapshot)
 
         if not overlap_staging and r + 1 < n_rounds:
             # Sequential mode: produce AND stage the next round's data after
@@ -907,6 +928,16 @@ def run_mesh_federation(
                 acct["live"] += next_bytes
                 acct["round_max"] = max(acct["round_max"], acct["live"])
 
+        wpc = getattr(round_fn, "wire_bytes_per_client", None)
+        bytes_per_round = None
+        if wpc:
+            try:
+                n_active = int(np.sum(np.asarray(active, np.float32) > 0.0))
+            except Exception:
+                # Cross-process sharded cohort mask: this process cannot
+                # fetch it — charge the full client axis.
+                n_active = int(mesh.shape[CLIENTS]) if CLIENTS in mesh.shape else 1
+            bytes_per_round = int(wpc) * n_active
         record = RoundRecord(
             round_idx=r,
             metrics=metrics_host,
@@ -920,6 +951,7 @@ def run_mesh_federation(
             retries=attempt,
             faults=tuple(round_faults),
             data_placement="resident" if resident else "streamed",
+            bytes_per_round=bytes_per_round,
         )
         records.append(record)
         if on_round is not None:

@@ -31,8 +31,14 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from fedcrack_tpu.compress.codecs import encoded_bytes_model
+from fedcrack_tpu.compress.mesh import (
+    int8_roundtrip,
+    topk_roundtrip,
+    validate_mesh_codec,
+)
 from fedcrack_tpu.configs import ModelConfig
 from fedcrack_tpu.data.pipeline import as_model_batch
 from fedcrack_tpu.fed.algorithms import fedprox_penalty
@@ -225,6 +231,20 @@ def _require_axes(mesh: Mesh, *axes: str) -> None:
         )
 
 
+def _tree_sub(a, b):
+    return jax.tree_util.tree_map(
+        lambda x, y: x.astype(jnp.float32) - y.astype(jnp.float32), a, b
+    )
+
+
+def _tree_add_cast(base, delta):
+    return jax.tree_util.tree_map(
+        lambda b, d: (b.astype(jnp.float32) + d.astype(jnp.float32)).astype(b.dtype),
+        base,
+        delta,
+    )
+
+
 def _build_round(
     mesh: Mesh,
     model_config: ModelConfig,
@@ -239,6 +259,8 @@ def _build_round(
     pos_weight: float = 1.0,
     remat: bool = False,
     data_placement: str = "streamed",
+    update_codec: str | None = None,
+    topk_fraction: float = 0.01,
 ):
     """Shared core of the one-program federated round.
 
@@ -280,8 +302,22 @@ def _build_round(
         raise ValueError(
             f"data_placement must be 'streamed' or 'resident', got {data_placement!r}"
         )
+    # On-device update-compression twin (round 12, compress/mesh.py): apply
+    # the codec's encode∘decode value map to each client's round delta
+    # BEFORE the FedAvg psum, so the mesh trajectory reflects exactly what
+    # the gRPC plane's compressed uploads would aggregate to — at zero host
+    # cost. "null" leaves the traced program UNTOUCHED (the conditionals
+    # below are Python-level, so the null build is byte-identical to a
+    # pre-codec build — test-pinned).
+    codec = validate_mesh_codec(update_codec)
+    if not 0.0 < topk_fraction <= 1.0:
+        raise ValueError(f"topk_fraction must be in (0, 1], got {topk_fraction}")
+    topk = codec == "topk_delta"
 
-    def client_fit(variables, data_a, data_b, active, n_samples):
+    # `extra` is the codec's side channel: the P('clients')-sharded
+    # error-feedback pytree for topk_delta, the replicated per-call seed
+    # scalar for int8's stochastic rounding, absent for null.
+    def client_fit(variables, data_a, data_b, active, n_samples, extra=None):
         # Per-shard blocks: leading clients-axis block is exactly one client.
         # Streamed: data_a/data_b are the [C, steps, B, ...] epoch slabs.
         # Resident: data_a is the (pool_images, pool_masks) pair, data_b the
@@ -315,6 +351,39 @@ def _build_round(
         )
         params, batch_stats, _ = carry
 
+        ef_out = None
+        if codec == "int8":
+            update = {"params": params, "batch_stats": batch_stats}
+            base = {"params": anchor, "batch_stats": variables["batch_stats"]}
+            # Per-client stochastic-rounding stream: the replicated per-call
+            # seed folded with this shard's client index.
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(extra), lax.axis_index(CLIENTS)
+            )
+            update = _tree_add_cast(
+                base, int8_roundtrip(_tree_sub(update, base), key)
+            )
+            params, batch_stats = update["params"], update["batch_stats"]
+        elif topk:
+            update = {"params": params, "batch_stats": batch_stats}
+            base = {"params": anchor, "batch_stats": variables["batch_stats"]}
+            ef_block = jax.tree_util.tree_map(lambda x: x[0], extra)
+            kept, ef_new = topk_roundtrip(
+                _tree_sub(update, base), ef_block, topk_fraction
+            )
+            update = _tree_add_cast(base, kept)
+            params, batch_stats = update["params"], update["batch_stats"]
+            # EF advances only for ACTIVE clients: on the wire an inactive
+            # client never encodes, so its residual is untouched — without
+            # this gate the twin would bank residual mass from a delta the
+            # round's active-mask discards and leak it into the client's
+            # next active round, diverging from the host-codec semantics.
+            is_active = active[0] > 0.0
+            ef_new = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(is_active, new, old), ef_new, ef_block
+            )
+            ef_out = jax.tree_util.tree_map(lambda x: x[None], ef_new)
+
         new_variables = _aggregate_and_guard(
             params,
             batch_stats,
@@ -333,6 +402,8 @@ def _build_round(
         }
         # [1]-shaped leaves tile back onto the clients axis.
         metrics = jax.tree_util.tree_map(lambda a: a[None], metrics)
+        if topk:
+            return new_variables, metrics, ef_out
         return new_variables, metrics
 
     if resident:
@@ -345,13 +416,83 @@ def _build_round(
         )
     else:
         in_specs = (P(), image_spec, image_spec, P(CLIENTS), P(CLIENTS))
-    sharded = shard_map(
-        client_fit,
-        mesh=mesh,
-        in_specs=in_specs,
-        out_specs=(P(), P(CLIENTS)),
-    )
+    if topk:
+        # The error-feedback accumulator rides through the program as a
+        # P('clients')-sharded pytree: in as this round's residual, out as
+        # the next round's — it never leaves device.
+        sharded = shard_map(
+            client_fit,
+            mesh=mesh,
+            in_specs=in_specs + (P(CLIENTS),),
+            out_specs=(P(), P(CLIENTS), P(CLIENTS)),
+        )
+    elif codec == "int8":
+        # One replicated uint32 seed per call feeds the stochastic rounding.
+        sharded = shard_map(
+            client_fit,
+            mesh=mesh,
+            in_specs=in_specs + (P(),),
+            out_specs=(P(), P(CLIENTS)),
+        )
+    else:
+        sharded = shard_map(
+            client_fit,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=(P(), P(CLIENTS)),
+        )
     jitted = jax.jit(sharded)
+
+    def _wire_bytes_per_client(variables) -> int:
+        """Analytic wire bytes ONE client's upload would cost under this
+        codec (compress.codecs.encoded_bytes_model) — the mesh plane never
+        materializes host bytes, so the counter is a model, not a measure."""
+        sizes = [
+            int(leaf.size)
+            for leaf in jax.tree_util.tree_leaves(
+                {
+                    "params": variables["params"],
+                    "batch_stats": variables["batch_stats"],
+                }
+            )
+        ]
+        return encoded_bytes_model(sizes, codec, topk_fraction=topk_fraction)
+
+    def _init_ef(variables):
+        """Round-0 error-feedback state: per-client float32 zeros for every
+        update leaf, placed sharded P('clients') — C model-sized copies of
+        HBM, the price of faithful per-client DGC on the mesh."""
+        zeros = jax.tree_util.tree_map(
+            lambda t: np.zeros((n_client_shards,) + tuple(np.shape(t)), np.float32),
+            {"params": variables["params"], "batch_stats": variables["batch_stats"]},
+        )
+        return jax.device_put(zeros, NamedSharding(mesh, P(CLIENTS)))
+
+    ef_state: dict = {"ef": None, "calls": 0}
+
+    def _dispatch(variables, *data_args):
+        """Shared jitted-call tail: lazily prices the wire-bytes counter
+        from the first call's leaf sizes and threads the codec side
+        channel — the device-resident error-feedback state for the topk
+        twin, or the call-counter seed for int8's stochastic rounding.
+        Both commit as soon as the async dispatch returns — BEFORE a
+        non-finite output can surface at the host fetch — so a replaying
+        driver must restore ``codec_state()`` alongside its weights
+        snapshot (parallel.driver does; the null twin carries no state)."""
+        if round_fn.wire_bytes_per_client is None:
+            round_fn.wire_bytes_per_client = _wire_bytes_per_client(variables)
+        if codec == "int8":
+            seed = jnp.uint32(ef_state["calls"])
+            out = jitted(variables, *data_args, seed)
+            ef_state["calls"] += 1
+            return out
+        if not topk:
+            return jitted(variables, *data_args)
+        if ef_state["ef"] is None:
+            ef_state["ef"] = _init_ef(variables)
+        new_vars, metrics, ef_new = jitted(variables, *data_args, ef_state["ef"])
+        ef_state["ef"] = ef_new
+        return new_vars, metrics
 
     if resident:
 
@@ -361,7 +502,7 @@ def _build_round(
                 n_inner, validate_data,
             )
             active, n_samples = _host_cohort_check(active, n_samples)
-            return jitted(variables, tuple(pool), idx, active, n_samples)
+            return _dispatch(variables, tuple(pool), idx, active, n_samples)
 
     else:
 
@@ -381,11 +522,36 @@ def _build_round(
             # incoming global model unchanged; see the `keep` guard in
             # client_fit).
             active, n_samples = _host_cohort_check(active, n_samples)
-            return jitted(variables, images, masks, active, n_samples)
+            return _dispatch(variables, images, masks, active, n_samples)
 
     # Drivers key on this tag to refuse a round/data-contract mismatch
     # before any bytes move (parallel.driver.run_mesh_federation).
     round_fn.data_placement = data_placement
+    # Compressed-transport observability (round 12): which codec twin this
+    # round simulates, the analytic per-client upload bytes under it
+    # (priced on first call; parallel.driver folds it into
+    # RoundRecord.bytes_per_round), and — for the topk twin — a reset hook
+    # dropping the cross-round error-feedback state.
+    round_fn.update_codec = codec
+    round_fn.wire_bytes_per_client = None
+    round_fn.reset_ef = lambda: ef_state.update(ef=None, calls=0)
+    # Test hook: the device-resident EF pytree ([C, ...] per leaf), None
+    # before the first topk dispatch. Read-only observability.
+    round_fn.ef_state = lambda: ef_state["ef"]
+    # Retry contract (r12 review fix): a failed round attempt surfaces
+    # AFTER the async dispatch already committed this state (JAX defers
+    # the non-finite discovery to the host fetch), so the driver's
+    # replay path snapshots it alongside its weights snapshot and
+    # restores it before the retry — otherwise the topk twin banks
+    # residual mass from a round that was never applied (kept mass lost,
+    # dropped mass double-counted) and the int8 seed counter drifts.
+    # Shallow dict copy is a true snapshot: "ef" holds immutable jax
+    # arrays (pointer copy suffices), "calls" an int. Restoring makes
+    # the replayed attempt BIT-identical for every codec twin.
+    round_fn.codec_state = lambda: dict(ef_state)
+    round_fn.set_codec_state = lambda s: (
+        ef_state.clear(), ef_state.update(s)
+    )
     return round_fn
 
 
@@ -497,6 +663,8 @@ def build_federated_round(
     pos_weight: float = 1.0,
     remat: bool = False,
     data_placement: str = "streamed",
+    update_codec: str | None = None,
+    topk_fraction: float = 0.01,
 ):
     """Compile-once round function over ``Mesh(('clients', 'batch'))``.
 
@@ -532,6 +700,18 @@ def build_federated_round(
     int32 gather plan — byte-identical to this streamed round over
     ``pool[idx]`` (test-pinned), at kilobytes of per-round staging instead
     of the full epoch slab.
+
+    ``update_codec`` (round 12): ``None``/``"null"`` leaves the program
+    untouched (byte-identical to a pre-codec build, test-pinned);
+    ``"int8"``/``"topk_delta"`` apply the on-device encode∘decode twin of
+    the wire codec to each client's round delta before the FedAvg psum
+    (``compress.mesh``), so ``run_mesh_federation`` A/Bs compressed-
+    trajectory quality at zero host cost. The topk twin carries its
+    per-client error-feedback accumulator device-resident across calls
+    (``round_fn.reset_ef()`` drops it); the returned ``round_fn`` also
+    tags ``update_codec`` and prices ``wire_bytes_per_client`` on first
+    call for the driver's ``bytes_per_round`` counter. The codec twin is
+    monolithic-only — ``build_federated_round_segments`` has no codec arg.
     """
     model_config = model_config or ModelConfig()
     _require_axes(mesh, CLIENTS, BATCH)
@@ -549,6 +729,8 @@ def build_federated_round(
         pos_weight=pos_weight,
         remat=remat,
         data_placement=data_placement,
+        update_codec=update_codec,
+        topk_fraction=topk_fraction,
     )
 
 

@@ -68,6 +68,29 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         help="weight payload dtype on the control plane: float32 or "
         "bfloat16 (halves upload+broadcast bytes; server math stays f32)",
     )
+    p.add_argument(
+        "--update-codec",
+        dest="update_codec",
+        help="compressed update transport (fedcrack_tpu/compress): null "
+        "(today's raw bytes, bit-exact), int8 (quantized round delta), or "
+        "topk_delta (top-k sparsified delta with client-side error "
+        "feedback); advertised to the cohort in-band at enroll",
+    )
+    p.add_argument(
+        "--topk-fraction",
+        type=float,
+        dest="topk_fraction",
+        help="topk_delta keep fraction per leaf (default 0.01 = ~50x fewer "
+        "upload bytes before framing)",
+    )
+    p.add_argument(
+        "--max-message-mb",
+        type=int,
+        dest="max_message_mb",
+        help="gRPC send/receive cap in MiB, both directions (the reference "
+        "hardcoded 512 for full-weight pickles); startup asserts the "
+        "worst-case weight message under the configured codec fits",
+    )
     p.add_argument("--seed", type=int, help="PRNG seed for the initial global model")
     p.add_argument(
         "--ckpt-dir",
@@ -168,6 +191,9 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         ("server_lr", "server_lr"),
         ("server_momentum", "server_momentum"),
         ("wire_dtype", "wire_dtype"),
+        ("update_codec", "update_codec"),
+        ("topk_fraction", "topk_fraction"),
+        ("max_message_mb", "max_message_mb"),
         ("ckpt_dir", "ckpt_dir"),
         ("seed", "seed"),
         ("metrics_path", "metrics_path"),

@@ -175,6 +175,88 @@ def run_kill_restart_drill(rounds: int = 3, workdir: str | None = None) -> dict:
             ctx.cleanup()
 
 
+def run_corrupt_frame_drill() -> dict:
+    """CORRUPT_COMPRESSED_FRAME drill (round 12): a cohort uploading int8
+    compressed frames where one client's frame takes a single bit-flip on
+    the wire. The server must reject it on the frame CRC — BEFORE any
+    reconstruction — log it to the round's ``rejected`` history map, and
+    still close the round at quorum from the two clean frames. The
+    aggregation result is checked EXACTLY against the weighted average of
+    what decode_update reconstructs from the two clean frames (int8 encode
+    is seeded, so frames and reconstructions are deterministic)."""
+    from fedcrack_tpu.chaos.inject import _poison_weights
+    from fedcrack_tpu.chaos.plan import CORRUPT_COMPRESSED_FRAME
+    from fedcrack_tpu.compress import decode_update, get_codec, is_frame
+    from fedcrack_tpu.transport import transport_pb2 as pb
+    from fedcrack_tpu.transport.service import FedServer, ServerThread
+
+    cfg = FedConfig(
+        max_rounds=1,
+        cohort_size=3,
+        quorum_fraction=2.0 / 3.0,  # 2 of 3: the poisoned client must not stall it
+        registration_window_s=5.0,
+        round_deadline_s=60.0,
+        update_codec="int8",
+        port=0,
+    )
+    base_vars = _vars(0.0)
+    server = FedServer(cfg, base_vars, tick_period_s=0.02)
+    base_blob = server.state.broadcast_blob
+
+    def framed(cname: str, value: float, ns: int, corrupt: bool) -> pb.ClientMessage:
+        frame = get_codec("int8", client_tag=cname).encode_update(
+            tree_to_bytes(_vars(value)), base_blob, round=1, base_version=0
+        )
+        assert is_frame(frame)
+        if corrupt:
+            frame = _poison_weights(frame, CORRUPT_COMPRESSED_FRAME)
+        msg = pb.ClientMessage(cname=cname)
+        msg.done.round = 1
+        msg.done.weights = frame
+        msg.done.sample_count = ns
+        return msg
+
+    t0 = time.perf_counter()
+    with ServerThread(server) as st:
+        channel, call = _raw_caller(st.port)
+        for c in ("a", "b", "c"):
+            assert call(_ready(c)).status == R.SW
+        # The corrupt frame lands FIRST: rejection, not a stale-round resync.
+        rej = call(framed("c", 9.0, 20, corrupt=True))
+        rep_a = call(framed("a", 1.0, 10, corrupt=False))
+        rep_b = call(framed("b", 3.0, 30, corrupt=False))
+        t_quorum = time.perf_counter()
+        channel.close()
+        state = st.state
+    got = tree_from_bytes(rep_b.weights)["params"]["w"]
+    base_tree = tree_from_bytes(base_blob)
+    dec = {}
+    for cname, value in (("a", 1.0), ("b", 3.0)):
+        frame = get_codec("int8", client_tag=cname).encode_update(
+            tree_to_bytes(_vars(value)), base_blob, round=1, base_version=0
+        )
+        tree, _ = decode_update(
+            frame, template=base_tree, base=base_tree, expected_base_version=0
+        )
+        dec[cname] = np.asarray(tree["params"]["w"], np.float32)
+    want = (10 * dec["a"] + 30 * dec["b"]) / 40
+    entry = state.history[0] if state.history else {}
+    return {
+        "corrupt_rejected": rej.status == R.REJECTED,
+        "reject_reason_is_checksum": "checksum" in (
+            entry.get("rejected", {}).get("c", "")
+        ),
+        "quorum_reached": rep_a.status == R.RESP_ACY
+        and rep_b.status in (R.RESP_ARY, R.FIN),
+        "clean_clients_aggregated": entry.get("clients") == ["a", "b"],
+        "codecs": entry.get("codecs"),
+        "wire_bytes_received": entry.get("bytes_received"),
+        "decoded_bytes_received": entry.get("decoded_bytes_received"),
+        "avg_matches_decoded_frames": bool(np.allclose(got, want, atol=1e-5)),
+        "reject_to_quorum_s": round(t_quorum - t0, 4),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--out", required=True)
@@ -183,6 +265,7 @@ def main(argv=None) -> int:
     artifact = {
         "generated_by": "fedcrack_tpu.tools.chaos_drill",
         "kill_restart": run_kill_restart_drill(rounds=args.rounds),
+        "corrupt_frame": run_corrupt_frame_drill(),
     }
     os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
     with open(args.out, "w") as f:

@@ -28,6 +28,7 @@ from typing import Any, Callable, Sequence
 
 import grpc
 
+from fedcrack_tpu.compress import get_codec
 from fedcrack_tpu.configs import FedConfig
 from fedcrack_tpu.fed import rounds as R
 from fedcrack_tpu.native import crc32c
@@ -105,6 +106,9 @@ class FedClient:
         # Server hyperparameters from the enroll handshake (set in
         # run_session; exposed for callers/tests).
         self.server_hparams: dict[str, Any] = {}
+        # Upload codec, replaced by the negotiated one at enroll. Null until
+        # then — today's raw bytes.
+        self.codec = get_codec("null")
         # Files shipped to the server's log sink after the final round
         # (reference C2.1: the 'L' chunked uploader, fl_client.py:35-50 —
         # present there but its call site was commented out; enabled here).
@@ -237,9 +241,20 @@ class FedClient:
                     "learning_rate",
                     "fedprox_mu",
                     "wire_dtype",
+                    "update_codec",
+                    "topk_fraction",
                 )
                 if k in cfg
             }
+            # Compressed update transport (round 12): the server advertises
+            # the upload codec in-band like every other hyperparameter. The
+            # codec instance is PER CLIENT and lives for the whole session —
+            # TopKDelta's error-feedback accumulator is cross-round state.
+            self.codec = get_codec(
+                str(cfg.get("update_codec", "null") or "null"),
+                topk_fraction=float(cfg.get("topk_fraction", 0.01) or 0.01),
+                client_tag=self.cname,
+            )
 
             # Phase 2: pull global weights (reference 'P', fl_client.py:99-102)
             msg = self._msg()
@@ -253,6 +268,11 @@ class FedClient:
                 self._call(method, msg)
 
                 # Phase 4: local fit (reference: manage_train, §3.3)
+                # `weights` at this point is the round BASE — the global
+                # blob the server broadcast for this round. Delta codecs
+                # encode (trained - base) against it, pinned server-side by
+                # the frame's base_version == this round's model_version.
+                round_base = weights
                 if self._train_takes_hparams:
                     weights, n_samples, metrics = self.train_fn(
                         weights, current_round, self.server_hparams
@@ -261,12 +281,26 @@ class FedClient:
                     weights, n_samples, metrics = self.train_fn(
                         weights, current_round
                     )
-                result.history.append({"round": current_round, **metrics})
 
-                # Phase 5: report (reference 'D', fl_client.py:124-127)
+                # Phase 5: report (reference 'D', fl_client.py:124-127).
+                # The upload is the codec's encoding; local `weights` stay
+                # the full trained blob (the codec only shapes the wire).
+                upload = self.codec.encode_update(
+                    weights,
+                    round_base,
+                    round=current_round,
+                    base_version=model_version,
+                )
+                result.history.append(
+                    {
+                        "round": current_round,
+                        "upload_bytes": len(upload),
+                        **metrics,
+                    }
+                )
                 msg = self._msg()
                 msg.done.round = current_round
-                msg.done.weights = weights
+                msg.done.weights = upload
                 msg.done.sample_count = n_samples
                 encode_scalar_map(
                     msg.done.metrics,
@@ -274,6 +308,20 @@ class FedClient:
                 )
                 rep = self._call(method, msg)
 
+                if rep.status == R.NOT_WAIT:
+                    # Straggler past quorum: a NOT_WAIT on the TrainDone
+                    # reply ITSELF means the round closed WITHOUT this
+                    # upload (rounds.py stale-round resync) — whatever
+                    # cross-round state the codec committed at encode (the
+                    # top-k mass dropped from the error-feedback
+                    # accumulator) was never applied to the global. Give it
+                    # back, or it is lost forever. A NOT_WAIT from the
+                    # post-accept poll below is the OPPOSITE case — the
+                    # accepted upload WAS averaged and a new round is ready
+                    # — so the rollback must key on the direct reply only
+                    # (rolling back aggregated mass would re-transmit it
+                    # next round: applied twice, not 'only delayed').
+                    self.codec.rollback_last()
                 if rep.status == R.RESP_ACY:
                     rep = self._poll(method, model_version, current_round)
                 if rep.status == R.REJECTED:

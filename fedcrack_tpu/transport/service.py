@@ -169,6 +169,48 @@ class FedServer:
                     len(mid.received),
                 )
                 self.state = mid
+        # Startup contract for the configurable message cap (round 12): the
+        # largest message either direction ever carries — the dense
+        # broadcast blob down, or the worst-case update (dense for "null",
+        # the codec's frame bound otherwise) up — must fit the configured
+        # gRPC cap, or the federation would boot and then die on the first
+        # weight transfer. Fail at construction, where the operator reads
+        # the config error, not mid-round.
+        import jax
+        import numpy as np
+
+        from fedcrack_tpu.compress import FRAME_OVERHEAD_BYTES, encoded_bytes_model
+
+        cap = config.max_message_mb * 1024 * 1024
+        # Leaf-aware worst case: encoded_bytes_model prices the per-leaf
+        # floors (topk's k >= 1, manifest entries) a dense-length fraction
+        # misses on many-small-leaf models; 64 B/leaf covers manifest keys
+        # and zlib-level-1 expansion on incompressible payloads. The dense
+        # blob stays in the max: legacy raw uploads are always accepted.
+        leaf_sizes = [
+            int(np.asarray(leaf).size)
+            for leaf in jax.tree_util.tree_leaves(self.state.template)
+        ]
+        frame_budget = (
+            encoded_bytes_model(
+                leaf_sizes, config.update_codec, topk_fraction=config.topk_fraction
+            )
+            + FRAME_OVERHEAD_BYTES
+            + 64 * len(leaf_sizes)
+        )
+        budget = max(
+            len(self.state.global_blob),
+            len(self.state.broadcast_blob),
+            frame_budget,
+        )
+        if budget > cap:
+            raise ValueError(
+                f"max_message_mb={config.max_message_mb} cannot carry this "
+                f"model: worst-case weight message is {budget} bytes "
+                f"({budget / (1024 * 1024):.1f} MiB) under "
+                f"update_codec={config.update_codec!r} — raise "
+                "max_message_mb (server and clients must agree)"
+            )
         self._metrics = metrics
         # Per-round evaluation of the freshly aggregated global model
         # (the reference designed this — trainNextRound, fl_server.py:27-37 —
@@ -258,8 +300,15 @@ class FedServer:
             # the reference printed banners instead). Offloaded like the
             # checkpoint save: a stalled flush must not freeze the loop.
             entry = state.history[-1]
+            # bytes_per_round mirrors the mesh plane's RoundRecord counter
+            # name (round 12): the wire bytes this round's uploads cost.
             task = asyncio.create_task(
-                asyncio.to_thread(self._metrics.log, "round", **entry)
+                asyncio.to_thread(
+                    self._metrics.log,
+                    "round",
+                    bytes_per_round=entry.get("bytes_received"),
+                    **entry,
+                )
             )
             self._bg_tasks.add(task)
             task.add_done_callback(self._bg_tasks.discard)

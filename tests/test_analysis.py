@@ -231,6 +231,37 @@ def test_trans002_unknown_status_code():
     assert "TRANS002" not in rule_ids(lint(good, path="fedcrack_tpu/tools/fx.py"))
 
 
+# ---- compress pack ----
+
+def test_comp001_frame_decode_must_feed_validate_update():
+    bad = (
+        "from fedcrack_tpu.compress import decode_update\n"
+        "def take(blob, state):\n"
+        "    tree, frame = decode_update(blob, state.template, base)\n"
+        "    return aggregate(tree)\n"
+    )
+    assert "COMP001" in rule_ids(lint(bad, path="fedcrack_tpu/fed/fx.py"))
+    good = (
+        "from fedcrack_tpu.compress import decode_update\n"
+        "from fedcrack_tpu.fed.serialization import validate_update\n"
+        "def take(blob, state):\n"
+        "    tree, frame = decode_update(blob, state.template, base)\n"
+        "    problem = validate_update(to_bytes(tree), state.template)\n"
+        "    return None if problem else aggregate(tree)\n"
+    )
+    assert "COMP001" not in rule_ids(lint(good, path="fedcrack_tpu/fed/fx.py"))
+    # The decoder layer composing its own parses is exempt: decode_update
+    # returns trees, it does not feed the aggregator.
+    layer = (
+        "def decode_update(blob, template, base):\n"
+        "    frame = decode_frame(blob)\n"
+        "    return rebuild(frame, template, base)\n"
+    )
+    assert "COMP001" not in rule_ids(lint(layer, path="fedcrack_tpu/compress/fx.py"))
+    # Outside fed/ and compress/ the rule does not apply.
+    assert "COMP001" not in rule_ids(lint(bad, path="fedcrack_tpu/tools/fx.py"))
+
+
 # ---- lock-order pack (project scope: lint_modules, not lint_source) ----
 
 CYCLE_SRC = """\

@@ -102,6 +102,21 @@ def test_bench_smoke_emits_driver_contract(tmp_path):
         assert chaos is not None or any(
             s["section"] == "chaos_recovery" for s in detail["skipped"]
         )
+    # Round-12 update-compression A/B: present with the codec contract
+    # intact (null byte-identical, compressed codecs strictly cheaper on
+    # the wire at reference scale), or a RECORDED skip — never silent.
+    comp = detail.get("update_compression")
+    if comp is not None and "error" not in comp:
+        assert comp["wire"]["null"]["null_identical"] is True
+        assert comp["wire"]["null"]["bytes_per_round"] == comp["dense_update_bytes"]
+        for codec in ("int8", "topk_delta"):
+            assert comp["wire"][codec]["bytes_per_round"] < comp["dense_update_bytes"]
+            assert comp["wire"][codec]["ratio_vs_null"] > 1.0
+            assert len(comp["trajectory"][codec]["iou"]) == comp["rounds"]
+    else:
+        assert comp is not None or any(
+            s["section"] == "update_compression" for s in detail["skipped"]
+        )
 
 
 @pytest.mark.slow
@@ -186,6 +201,7 @@ def test_detail_schema_declares_contract_keys():
         "segmented_pipeline",
         "resident_pool",
         "serving",
+        "update_compression",
     }
     assert required <= set(bench.DETAIL_SCHEMA)
     # Round-10 serving arm: the SLO keys BASELINE.md reads must be declared.
@@ -195,11 +211,24 @@ def test_detail_schema_declares_contract_keys():
     assert {"round_ms", "round_plus_restage_ms", "staging_hidden_frac"} <= set(
         bench.REF_POINT_SCHEMA
     )
+    # Round-12 compression arm: the bytes/timing keys BASELINE.md reads.
+    assert {"dense_update_bytes", "rounds", "wire", "trajectory"} <= set(
+        bench.COMPRESSION_SCHEMA
+    )
+    assert {"bytes_per_round", "ratio_vs_null", "encode_ms", "decode_ms"} <= set(
+        bench.COMPRESSION_WIRE_SCHEMA
+    )
     # The schema cannot drift from the code that writes the payload: every
     # declared key must appear as a literal in bench.py's emitting code.
     with open(bench.__file__) as f:
         src = f.read()
-    for key in required | set(bench.REF_POINT_SCHEMA) | set(bench.SERVING_SCHEMA):
+    for key in (
+        required
+        | set(bench.REF_POINT_SCHEMA)
+        | set(bench.SERVING_SCHEMA)
+        | set(bench.COMPRESSION_SCHEMA)
+        | set(bench.COMPRESSION_WIRE_SCHEMA)
+    ):
         assert f'"{key}"' in src, f"schema key {key!r} never written by bench.py"
 
 
@@ -236,6 +265,26 @@ def test_validate_detail_typed_checks():
             "swap": {"to_version": 1, "load_ms": 35.0, "gap_ms": 4.0},
             "dropped": 0,
         },
+        "update_compression": {
+            "dense_update_bytes": 8236134,
+            "rounds": 3,
+            "wire": {
+                "null": {
+                    "bytes_per_round": 8236134,
+                    "ratio_vs_null": None,
+                    "encode_ms": 0.001,
+                    "decode_ms": 180.0,
+                    "null_identical": True,
+                },
+                "int8": {
+                    "bytes_per_round": 789082,
+                    "ratio_vs_null": 10.44,
+                    "encode_ms": 92.0,
+                    "decode_ms": 20.0,
+                },
+            },
+            "trajectory": {"null": {"iou": [0.1, 0.2, 0.3]}},
+        },
     }
     assert bench.validate_detail(good) == []
     assert bench.validate_detail({}) == []  # every section is optional
@@ -259,6 +308,34 @@ def test_validate_detail_typed_checks():
         resident_pool={"x": {"resident": {"round_ms": "slow"}}},
     )
     assert any("resident_pool" in v for v in bench.validate_detail(bad3))
+    # Round-12 compression arm: error-arm exempt, present arm fully typed.
+    assert bench.validate_detail({"update_compression": {"error": "boom"}}) == []
+    assert any(
+        "update_compression" in v
+        for v in bench.validate_detail({"update_compression": {"wire": {}}})
+    )
+    bad4 = dict(
+        good,
+        update_compression=dict(
+            good["update_compression"],
+            wire={"int8": {"bytes_per_round": "many"}},
+        ),
+    )
+    assert any("update_compression.wire" in v for v in bench.validate_detail(bad4))
+    # a non-dict wire must be REPORTED, not crash the validator
+    bad5 = dict(
+        good,
+        update_compression=dict(good["update_compression"], wire=["x"]),
+    )
+    assert any("wire" in v for v in bench.validate_detail(bad5))
+    # ... and so must a non-dict per-codec wire POINT (r12 review fix:
+    # previously a TypeError at `key not in point` aborted validation)
+    bad6 = dict(
+        good,
+        update_compression=dict(good["update_compression"], wire={"int8": 42}),
+    )
+    assert any("update_compression.wire['int8']" in v
+               for v in bench.validate_detail(bad6))
 
 
 def test_compact_summary_last_line_parses():

@@ -98,9 +98,16 @@ class TestMeshMatchesHost:
         assert metrics["loss"].shape == (8,)
         assert np.all(np.isfinite(np.asarray(metrics["loss"])))
 
+    @pytest.mark.slow
     def test_pos_weight_round_equals_host_round(self):
         """Crack-pixel loss weighting must train identically on both planes
-        (and actually change the trajectory vs plain BCE)."""
+        (and actually change the trajectory vs plain BCE).
+
+        Slow-marked (round-12 tier-1 budget re-balance, the r4/r9
+        precedent): a second full mesh+host compile whose parity machinery
+        is tier-1-pinned at pos_weight=1 by test_mesh_round_equals_host_round
+        and whose pos_weight numerics are tier-1-pinned host-side by
+        test_train/test_pallas_bce."""
         mesh = make_mesh(4, 1)
         images, masks = _client_data(4)
         variables = create_train_state(jax.random.key(11), TINY).variables

@@ -201,6 +201,7 @@ def test_refscale_federation_tool_smoke(tmp_path):
     assert art["workload"]["data_placement"] == "streamed"
 
 
+@pytest.mark.slow
 def test_ab_pallas_bce_harness_smoke(tmp_path):
     """The BCE-kernel A/B harness (tools/ab_pallas_bce) at toy scale:
     artifact schema + slope-fit wiring, single impl — the Pallas INTERPRETER
@@ -208,7 +209,10 @@ def test_ab_pallas_bce_harness_smoke(tmp_path):
     hlo_interpreter vma limitation), and the compiled kernel needs a real
     TPU, so the two-impl comparison is exercised only by the TPU artifact
     (bench_runs/r05_pallas_bce_ab.json). Kernel-vs-XLA numerics parity is
-    test_pallas_bce's job."""
+    test_pallas_bce's job. Slow-marked (round-12 tier-1 budget re-balance,
+    the r4/r9 precedent): ~80-95 s of tools-level compiles whose numeric
+    semantics stay tier-1 via test_pallas_bce and whose artifact schema is
+    retroactively validated by test_bench over bench_runs/."""
     import json
 
     from fedcrack_tpu.tools.ab_pallas_bce import main
@@ -239,9 +243,13 @@ def test_ab_pallas_bce_harness_smoke(tmp_path):
     assert os.environ.get("FEDCRACK_BCE_IMPL") is None
 
 
+@pytest.mark.slow
 def test_profile_step_tool_smoke(tmp_path):
     """tools/profile_step at toy scale: trace capture + xprof hlo_stats
-    aggregation (the machinery behind the 256 px north-star profile)."""
+    aggregation (the machinery behind the 256 px north-star profile).
+    Slow-marked (round-12 tier-1 budget re-balance, the r4/r9 precedent):
+    a tools-level smoke of display/profiling machinery — no protocol
+    semantics ride on it, and it still runs in the slow suite."""
     import json
 
     from fedcrack_tpu.tools.profile_step import main

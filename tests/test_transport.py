@@ -157,8 +157,16 @@ def test_late_client_turned_away(session_cfg):
 
 def test_dead_client_mid_round_cohort_shrinks(session_cfg):
     """Fault injection (SURVEY.md §5.3): one client dies after round 1; the
-    deadline shrinks the cohort and the survivor finishes alone."""
-    cfg = dataclasses.replace(session_cfg, round_deadline_s=0.5)
+    deadline shrinks the cohort and the survivor finishes alone.
+
+    The deadline is only here to drop the DEAD client — but it also races
+    the live one: a scheduler stall past it before the survivor's upload
+    lands either shrinks the cohort around the survivor (round 1) or fires
+    the zero-reports reopen (rounds 2-3), and the survivor's upload draws
+    'not in cohort'. 0.5 s flaked ~1/6 on this host's ~0.5-1 s ambient
+    stalls (pre-existing, seed-reproducible); 2.5 s clears them while
+    costing only the two post-death round waits."""
+    cfg = dataclasses.replace(session_cfg, round_deadline_s=2.5)
     server = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
 
     class DiesAfterRound1(Exception):
@@ -672,5 +680,7 @@ def test_handshake_hyperparameters_reach_trainer(session_cfg):
             "learning_rate": 0.005,
             "fedprox_mu": 0.125,
             "wire_dtype": "float32",
+            "update_codec": "null",
+            "topk_fraction": 0.01,
         }
     ]
