@@ -164,6 +164,21 @@ DETAIL_SCHEMA: dict = {
     "chaos_recovery": dict,
     "serving": dict,
     "update_compression": dict,
+    "cohort_scale": dict,
+}
+# Typed keys of detail.cohort_scale (round 13): the time-multiplexed-cohort
+# + hierarchical-tree contract — the group-count sweep's wall scaling, the
+# 1,024-simulated-client tree round's memory/byte accounting, and the
+# tree-vs-flat A/B.
+COHORT_SCALE_SCHEMA: dict = {
+    "groups": dict,
+    "tree": dict,
+    "flat": dict,
+}
+# Per-point keys of detail.cohort_scale.groups.*.
+COHORT_GROUP_SCHEMA: dict = {
+    "round_wall_s": (int, float),
+    "group_dispatches": int,
 }
 # Typed keys of detail.update_compression (round 12): the compressed-
 # transport A/B contract — real wire bytes + codec timings at reference
@@ -256,6 +271,26 @@ def validate_detail(detail: dict) -> list:
                         f"update_compression.wire[{name!r}][{key!r}]: "
                         f"{type(point[key]).__name__}"
                     )
+    cohort = detail.get("cohort_scale")
+    if isinstance(cohort, dict) and "error" not in cohort:
+        for key, typs in COHORT_SCALE_SCHEMA.items():
+            if key not in cohort:
+                bad.append(f"cohort_scale[{key!r}] missing")
+            elif not isinstance(cohort[key], typs):
+                bad.append(f"cohort_scale[{key!r}]: {type(cohort[key]).__name__}")
+        groups = cohort.get("groups")
+        for name, point in (groups if isinstance(groups, dict) else {}).items():
+            if not isinstance(point, dict):
+                bad.append(f"cohort_scale.groups[{name!r}]: {type(point).__name__}")
+                continue
+            for key, typs in COHORT_GROUP_SCHEMA.items():
+                if key not in point:
+                    bad.append(f"cohort_scale.groups[{name!r}][{key!r}] missing")
+                elif not isinstance(point[key], typs):
+                    bad.append(
+                        f"cohort_scale.groups[{name!r}][{key!r}]: "
+                        f"{type(point[key]).__name__}"
+                    )
     return bad
 
 # Default sized from measured section costs on the TPU-tunnel host (round 4):
@@ -289,6 +324,15 @@ CHAOS = os.environ.get("FEDCRACK_BENCH_CHAOS", "1") == "1"
 # out.
 COMPRESSION = os.environ.get("FEDCRACK_BENCH_COMPRESSION", "1") == "1"
 COMPRESSION_ROUNDS = int(os.environ.get("FEDCRACK_BENCH_COMPRESSION_ROUNDS", "3"))
+
+# Cohort-scale section (round 13, detail.cohort_scale): the group-count
+# sweep over the time-multiplexed cohort round (wall ~linear in
+# ceil(C/G) group dispatches, trajectory bitwise equal across splits), and
+# the 1,024-simulated-client round through the 2-level aggregation tree
+# with root-memory/byte accounting plus a flat-root A/B. "0" opts out.
+COHORT = os.environ.get("FEDCRACK_BENCH_COHORT", "1") == "1"
+COHORT_TREE_CLIENTS = int(os.environ.get("FEDCRACK_BENCH_COHORT_CLIENTS", "1024"))
+COHORT_TREE_FANOUT = int(os.environ.get("FEDCRACK_BENCH_COHORT_FANOUT", "32"))
 
 # Serving-plane SLO section (round 10, detail.serving): boots the full
 # serve stack in-process (engine + micro-batcher + hot-swap manager + gRPC
@@ -1824,6 +1868,187 @@ def _bench_update_compression(rounds: int = COMPRESSION_ROUNDS) -> dict:
     }
 
 
+def _bench_cohort_scale() -> dict:
+    """Cohort-scale A/B (round 13). Three pieces, all CPU-smoke cheap:
+
+    - **groups** — one 8-client cohort round executed time-multiplexed as
+      groups ∈ {1, 2, 4} over progressively narrower meshes (tiny model):
+      per-round wall vs group-dispatch count (the ~linear-in-ceil(C/G)
+      scaling claim) and the final-weights sha256 per split — all splits
+      must agree BITWISE (the ordered-fold contract, also test-pinned).
+    - **tree** — a ``COHORT_TREE_CLIENTS``-simulated-client round through
+      the 2-level aggregation tree (tiny 4x4 weight blobs — the protocol
+      and memory shape are what is under test, not the model): root/edge
+      peak resident update blobs, wire bytes at the root vs the flat
+      equivalent, wall, and a double-run bit-reproducibility check from
+      the cohort seed.
+    - **flat** — the same cohort through a flat root (every leaf enrolls
+      directly): peak resident blobs == cohort size, the O(N) shape the
+      tree removes.
+    """
+    import hashlib
+
+    from fedcrack_tpu.configs import FedConfig, ModelConfig
+    from fedcrack_tpu.data.synthetic import synth_crack_batch
+    from fedcrack_tpu.fed import rounds as R
+    from fedcrack_tpu.fed.algorithms import sample_cohort
+    from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
+    from fedcrack_tpu.fed.tree import run_tree_federation
+    from fedcrack_tpu.parallel import (
+        build_federated_cohort_round,
+        make_mesh,
+        run_cohort_federation,
+        stack_client_data,
+    )
+    from fedcrack_tpu.train.local import create_train_state
+
+    out: dict = {}
+
+    # ---- group-count sweep: time-multiplexed mesh execution ----
+    tiny = ModelConfig(
+        img_size=16, stem_features=4, encoder_features=(8,), decoder_features=(8, 4)
+    )
+    steps, batch, cohort_c = 2, 4, min(8, max(2, jax.device_count()))
+    per_client = [
+        synth_crack_batch(steps * batch, img_size=16, seed=i)
+        for i in range(cohort_c)
+    ]
+    images, masks = stack_client_data(per_client, steps, batch)
+    active = np.ones(cohort_c, np.float32)
+    ns = np.full(cohort_c, float(steps * batch), np.float32)
+    variables = create_train_state(jax.random.key(SEED), tiny).variables
+    groups_out: dict = {}
+    shas = set()
+    for n_groups in (1, 2, 4):
+        if cohort_c % n_groups:
+            continue
+        g = cohort_c // n_groups
+        mesh = make_mesh(g, 1)
+        cr = build_federated_cohort_round(
+            mesh, tiny, learning_rate=1e-3, local_epochs=1, segments=1
+        )
+        data_fn = lambda r: (images, masks, active, ns)
+        # One compile round, one measured round.
+        out_vars, recs = run_cohort_federation(cr, variables, data_fn, 2, mesh)
+        sha = hashlib.sha256(
+            tree_to_bytes(jax.device_get(out_vars))
+        ).hexdigest()
+        shas.add(sha)
+        groups_out[str(n_groups)] = {
+            "group_size": g,
+            "group_dispatches": n_groups,
+            "round_wall_s": round(recs[-1].wall_clock_s, 4),
+            "compile_round_wall_s": round(recs[0].wall_clock_s, 4),
+            "staged_bytes": recs[-1].staged_bytes,
+            "max_live_staged_bytes": recs[-1].max_live_staged_bytes,
+            "weights_sha256": sha,
+        }
+    out["groups"] = groups_out
+    out["groups_bitwise_equal"] = len(shas) == 1
+    out["cohort_size_mesh"] = cohort_c
+
+    # ---- 1,024-simulated-client tree round + flat A/B ----
+    def _vars(v):
+        return {"params": {"w": np.full((4, 4), v, np.float32)}}
+
+    def make_update(idx, r, base_blob, base_version):
+        rng = np.random.default_rng([7, idx, r])
+        base = tree_from_bytes(base_blob)
+        tree = {
+            "params": {
+                "w": np.asarray(base["params"]["w"], np.float32)
+                + rng.standard_normal((4, 4)).astype(np.float32) * 0.01
+            }
+        }
+        return tree_to_bytes(tree), int(rng.integers(1, 50))
+
+    n_tree = COHORT_TREE_CLIENTS
+    fan_out = COHORT_TREE_FANOUT
+    t0 = time.perf_counter()
+    res = run_tree_federation(
+        _vars(0.0),
+        make_update,
+        n_clients=4 * n_tree,
+        cohort_size=n_tree,
+        n_rounds=2,
+        n_edges=fan_out,
+        cohort_seed=SEED,
+    )
+    tree_wall = time.perf_counter() - t0
+    res2 = run_tree_federation(
+        _vars(0.0),
+        make_update,
+        n_clients=4 * n_tree,
+        cohort_size=n_tree,
+        n_rounds=2,
+        n_edges=fan_out,
+        cohort_seed=SEED,
+    )
+    out["tree"] = {
+        "n_clients": res.n_clients,
+        "cohort_size": res.cohort_size,
+        "fan_out": res.n_edges,
+        "rounds": res.rounds,
+        "root_peak_blobs": res.root_peak_blobs,
+        "edge_peak_blobs": res.edge_peak_blobs,
+        "max_leaf_fan_in": res.max_leaf_fan_in,
+        "root_peak_within_fan_in": res.root_peak_blobs <= res.n_edges,
+        "bytes_at_root": res.bytes_at_root,
+        "bytes_flat_equiv": res.bytes_flat_equiv,
+        "leaf_updates": res.leaf_updates,
+        "wall_s": round(tree_wall, 3),
+        "bit_reproducible": res.global_sha256 == res2.global_sha256,
+        "global_sha256": res.global_sha256,
+    }
+
+    cfg = FedConfig(
+        max_rounds=1,
+        cohort_size=n_tree,
+        registration_window_s=3600.0,
+        sanitize_updates=True,
+    )
+    state = R.initial_state(cfg, _vars(0.0))
+    cohort = sample_cohort(4 * n_tree, n_tree, 0, SEED)
+    now = 0.0
+    t0 = time.perf_counter()
+    for i in cohort:
+        now += 1e-4
+        state, _ = R.transition(state, R.Ready(cname=f"client-{int(i)}", now=now))
+    base_blob = state.broadcast_blob
+    flat_peak = 0
+    flat_bytes = 0
+    for i in cohort:
+        blob, n = make_update(int(i), 0, base_blob, state.model_version)
+        flat_bytes += len(blob)
+        now += 1e-4
+        state, rep = R.transition(
+            state,
+            R.TrainDone(
+                cname=f"client-{int(i)}", round=1, blob=blob, num_samples=n, now=now
+            ),
+        )
+        flat_peak = max(
+            flat_peak,
+            len(state.received) if rep.status != R.RESP_ARY and rep.status != R.FIN
+            else n_tree,
+        )
+    out["flat"] = {
+        "n_clients": n_tree,
+        "root_peak_blobs": flat_peak,
+        "bytes_at_root": flat_bytes,
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+    out["note"] = (
+        "groups: wall ~linear in group dispatches with BITWISE-equal "
+        "weights across splits (ordered-fold contract); tree: root peak "
+        "resident update blobs <= fan-out where the flat root holds the "
+        "whole cohort — the O(fan-in) memory claim; CPU smoke (protocol + "
+        "memory shape), the v5e-8 round-wall point is ROADMAP measurement "
+        "item 6"
+    )
+    return out
+
+
 def main() -> None:
     # Smoke-test hook: this image pre-imports jax at interpreter startup with
     # the axon (real TPU tunnel) platform, so a JAX_PLATFORMS=cpu env override
@@ -2371,6 +2596,29 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
                 skips,
                 "update_compression",
                 comp_est,
+                "estimate exceeds remaining budget",
+            )
+
+    # ---- cohort scale (round 13): the group-count sweep over the time-
+    # multiplexed cohort round (three grouped builds of the tiny model —
+    # compile-dominated, assume cold) plus the 1,024-simulated-client
+    # tree round and its flat A/B (host-only, tiny blobs, seconds) ----
+    if COHORT:
+        cohort_est = 3 * 30.0 + 20.0
+        if _fits(cohort_est):
+            t0 = time.monotonic()
+            try:
+                detail["cohort_scale"] = _bench_cohort_scale()
+            except Exception as e:  # a host-side extra must never kill the artifact
+                detail["cohort_scale"] = {"error": repr(e)}
+            section_s["cohort_scale"] = time.monotonic() - t0
+            detail["budget"] = _budget_detail()
+            _set_payload(metric_headline, value, vs_baseline, detail)
+        else:
+            _skip(
+                skips,
+                "cohort_scale",
+                cohort_est,
                 "estimate exceeds remaining budget",
             )
 

@@ -45,6 +45,15 @@ MESH_NONFINITE = "mesh_nonfinite"              # round output poisoned with NaNs
 SERVE_SWAP_MIDFLIGHT = "serve_swap_midflight"  # install a new model while a batch is in flight
 SERVE_DEVICE_LOSS = "serve_device_loss"        # batch dispatch raises (device loss)
 
+# Aggregation-tree plane (round 13). Like the server kill, a dead edge
+# process cannot run an in-process hook — this kind is consumed by the
+# scenario harnesses (tools/chaos_drill.run_edge_crash_drill,
+# tests/test_chaos.py), which kill the edge aggregator mid-round and
+# restart it from its statefile (fed.tree.EdgeAggregator.restore). The
+# plan still schedules and records it, so a scenario asserts the kill
+# actually fired instead of silently matching nothing.
+EDGE_AGGREGATOR_CRASH = "edge_aggregator_crash"  # edge tier dies mid-round, restarts from statefile
+
 CLIENT_KINDS = frozenset(
     {
         CRASH_BEFORE_UPLOAD,
@@ -61,7 +70,10 @@ CLIENT_KINDS = frozenset(
 )
 MESH_KINDS = frozenset({MESH_DEVICE_FAIL, MESH_NONFINITE})
 SERVE_KINDS = frozenset({SERVE_SWAP_MIDFLIGHT, SERVE_DEVICE_LOSS})
-ALL_KINDS = CLIENT_KINDS | MESH_KINDS | SERVE_KINDS
+# Scenario-harness kinds: consumed by scripted drills (a dead process runs
+# no hook); `client` carries the edge id.
+TREE_KINDS = frozenset({EDGE_AGGREGATOR_CRASH})
+ALL_KINDS = CLIENT_KINDS | MESH_KINDS | SERVE_KINDS | TREE_KINDS
 
 
 @dataclasses.dataclass(frozen=True)

@@ -192,6 +192,13 @@ class FedConfig:
 
     max_rounds: int = 5
     cohort_size: int = 2
+    # Seeded per-round cohort sampling (round 13): the seed behind
+    # fed.algorithms.sample_cohort — harnesses that sample `cohort_size`
+    # clients per round from a larger population (the time-multiplexed
+    # cohort plane, the hierarchical aggregation tree) derive every round's
+    # cohort from (cohort_seed, round), so the whole multi-round trajectory
+    # reproduces from this one number.
+    cohort_seed: int = 0
     local_epochs: int = 10
     learning_rate: float = 1e-3
     registration_window_s: float = 10.0
@@ -369,6 +376,12 @@ class FedConfig:
             raise ValueError(
                 "data_placement must be 'streamed' or 'resident', got "
                 f"{self.data_placement!r}"
+            )
+        if self.cohort_seed < 0:
+            # SeedSequence entropy must be non-negative; fail at config
+            # parse, not inside the first round's sample_cohort call.
+            raise ValueError(
+                f"cohort_seed must be >= 0, got {self.cohort_seed}"
             )
         if not 0.0 < self.quorum_fraction <= 1.0:
             raise ValueError(

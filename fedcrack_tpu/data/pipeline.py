@@ -188,6 +188,16 @@ class SamplePool:
         self.images = np.ascontiguousarray(images)
         self.masks = np.ascontiguousarray(masks)
         self.layout = layout
+        # Growable-pool bookkeeping (round 13 satellite, the serve→train
+        # flywheel's prerequisite): per-client VALID counts (capacity may
+        # exceed them after evictions) and per-client content digests of
+        # the STORED sample bytes, so append() preserves the pool's
+        # dedup invariant byte-exactly.
+        self._counts = np.full(self.images.shape[0], self.images.shape[1], np.int64)
+        # Built lazily on the first append/evict: hashing a reference-scale
+        # pool costs seconds, and read-only pools (every pre-flywheel user)
+        # never pay it.
+        self._digests: list[dict[bytes, int]] | None = None
 
     @classmethod
     def stack(
@@ -213,11 +223,165 @@ class SamplePool:
 
     @property
     def n_samples(self) -> int:
+        """Pool CAPACITY per client (the device array's sample axis);
+        :meth:`counts` gives the per-client valid counts, which trail
+        capacity after evictions."""
         return self.images.shape[1]
 
     @property
     def nbytes(self) -> int:
         return int(self.images.nbytes + self.masks.nbytes)
+
+    def counts(self) -> np.ndarray:
+        """Per-client valid sample counts ``[C]`` (gather plans index only
+        ``[0, counts[c])``; capacity lanes past them are retired padding)."""
+        return self._counts.copy()
+
+    @staticmethod
+    def _digest(image: np.ndarray, mask: np.ndarray) -> bytes:
+        import hashlib
+
+        h = hashlib.sha256(np.ascontiguousarray(image).tobytes())
+        h.update(np.ascontiguousarray(mask).tobytes())
+        return h.digest()
+
+    def _ensure_digests(self) -> list[dict[bytes, int]]:
+        if self._digests is None:
+            self._digests = [
+                {
+                    self._digest(self.images[c, i], self.masks[c, i]): i
+                    for i in range(int(self._counts[c]))
+                }
+                for c in range(self.n_clients)
+            ]
+        return self._digests
+
+    def append(self, client: int, images: np.ndarray, masks: np.ndarray) -> int:
+        """Grow one client's pool by the given ``[k, H, W, ch]`` samples
+        (REFERENCE layout in; an ``s2d`` pool packs on the way in, exactly
+        like the constructor), skipping any sample whose stored bytes are
+        already in that client's pool — the dedup invariant the resident
+        plane was built on survives growth. Returns how many samples were
+        actually kept.
+
+        Capacity grows for ALL clients when one client outgrows it (the
+        mesh round's static shapes want one rectangular ``[C, N, ...]``
+        placement); other clients' new lanes are zero padding outside
+        their valid counts. The host twin stays the byte oracle: a staged
+        device pool is a bit-exact copy of these arrays, so re-staging
+        after an append reproduces gathers over the old indices exactly.
+        """
+        if not 0 <= client < self.n_clients:
+            raise ValueError(f"client {client} outside [0, {self.n_clients})")
+        images = np.asarray(images)
+        masks = np.asarray(masks)
+        if images.ndim != 4 or masks.ndim != 4:
+            raise ValueError(
+                "append wants [k, H, W, ch] images and [k, H, W, 1] masks; "
+                f"got {images.shape} / {masks.shape}"
+            )
+        if images.shape[0] != masks.shape[0]:
+            raise ValueError(
+                f"images/masks disagree on k: {images.shape[0]} vs {masks.shape[0]}"
+            )
+        if self.layout == "s2d":
+            images = space_to_depth_images(images)
+        if images.shape[1:] != self.images.shape[2:]:
+            raise ValueError(
+                f"sample shape {images.shape[1:]} does not match pool "
+                f"{self.images.shape[2:]}"
+            )
+        if masks.shape[1:] != self.masks.shape[2:]:
+            raise ValueError(
+                f"mask shape {masks.shape[1:]} does not match pool "
+                f"{self.masks.shape[2:]}"
+            )
+        images = images.astype(self.images.dtype, copy=False)
+        masks = masks.astype(self.masks.dtype, copy=False)
+        fresh_i, fresh_m, fresh_d = [], [], []
+        seen = self._ensure_digests()[client]
+        for i in range(images.shape[0]):
+            d = self._digest(images[i], masks[i])
+            if d in seen or any(d == fd for fd in fresh_d):
+                continue
+            fresh_i.append(images[i])
+            fresh_m.append(masks[i])
+            fresh_d.append(d)
+        if not fresh_i:
+            return 0
+        need = int(self._counts[client]) + len(fresh_i)
+        if need > self.n_samples:
+            grow = need - self.n_samples
+            self.images = np.ascontiguousarray(
+                np.concatenate(
+                    [
+                        self.images,
+                        np.zeros(
+                            (self.n_clients, grow) + self.images.shape[2:],
+                            self.images.dtype,
+                        ),
+                    ],
+                    axis=1,
+                )
+            )
+            self.masks = np.ascontiguousarray(
+                np.concatenate(
+                    [
+                        self.masks,
+                        np.zeros(
+                            (self.n_clients, grow) + self.masks.shape[2:],
+                            self.masks.dtype,
+                        ),
+                    ],
+                    axis=1,
+                )
+            )
+        base = int(self._counts[client])
+        for j, (im, mk, d) in enumerate(zip(fresh_i, fresh_m, fresh_d)):
+            self.images[client, base + j] = im
+            self.masks[client, base + j] = mk
+            seen[d] = base + j
+        self._counts[client] = base + len(fresh_i)
+        return len(fresh_i)
+
+    def evict(self, client: int, indices) -> int:
+        """Retire samples from one client's pool by index. The survivors
+        compact to the front IN ORDER (so a plan regenerated from the new
+        counts stays dense) and the freed tail lanes zero out; capacity
+        never shrinks — the device placement's shape is stable until the
+        next capacity growth. Returns how many samples were evicted.
+        Out-of-range / already-invalid indices are an error (silently
+        ignoring them would desync the dedup digests)."""
+        if not 0 <= client < self.n_clients:
+            raise ValueError(f"client {client} outside [0, {self.n_clients})")
+        self._ensure_digests()
+        n_valid = int(self._counts[client])
+        drop = sorted(set(int(i) for i in np.atleast_1d(np.asarray(indices))))
+        if not drop:
+            return 0
+        if drop[0] < 0 or drop[-1] >= n_valid:
+            raise ValueError(
+                f"evict indices {drop} outside the valid range [0, {n_valid})"
+            )
+        drop_set = set(drop)
+        keep = [i for i in range(n_valid) if i not in drop_set]
+        new_imgs = self.images[client, keep]
+        new_msks = self.masks[client, keep]
+        self.images[client, : len(keep)] = new_imgs
+        self.masks[client, : len(keep)] = new_msks
+        self.images[client, len(keep) : n_valid] = 0
+        self.masks[client, len(keep) : n_valid] = 0
+        self._counts[client] = len(keep)
+        # Remap the surviving digests to their compacted indices instead of
+        # re-hashing the whole surviving pool (hashing a reference-scale
+        # client costs seconds; the digests already exist).
+        remap = {old: new for new, old in enumerate(keep)}
+        self._digests[client] = {
+            d: remap[i]
+            for d, i in self._digests[client].items()
+            if i in remap
+        }
+        return len(drop)
 
     def round_indices(
         self,
@@ -238,11 +402,19 @@ class SamplePool:
         if len(rngs) != self.n_clients:
             raise ValueError(f"{len(rngs)} rngs for {self.n_clients} clients")
         need = steps * batch_size
-        if self.n_samples < need:
-            raise ValueError(f"pool has {self.n_samples} samples, round needs {need}")
         per_client = []
-        for rng in rngs:
-            perm = rng.permutation(self.n_samples)[:need].reshape(steps, batch_size)
+        for c, rng in enumerate(rngs):
+            # Permute each client's VALID samples only (== the whole pool
+            # until the first append/evict, so untouched pools consume the
+            # rng identically to the pre-growable plane — the byte-oracle
+            # parity the resident tests pin).
+            n_valid = int(self._counts[c])
+            if n_valid < need:
+                raise ValueError(
+                    f"client {c} pool has {n_valid} valid samples, round "
+                    f"needs {need}"
+                )
+            perm = rng.permutation(n_valid)[:need].reshape(steps, batch_size)
             per_client.append(np.broadcast_to(perm, (max(1, epochs), steps, batch_size)))
         return np.ascontiguousarray(np.stack(per_client).astype(np.int32))
 

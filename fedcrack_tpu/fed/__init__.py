@@ -1,4 +1,8 @@
-from fedcrack_tpu.fed.algorithms import fedavg, fedprox_penalty  # noqa: F401
+from fedcrack_tpu.fed.algorithms import (  # noqa: F401
+    fedavg,
+    fedprox_penalty,
+    sample_cohort,
+)
 from fedcrack_tpu.fed.serialization import (  # noqa: F401
     tree_from_bytes,
     tree_to_bytes,
@@ -6,6 +10,8 @@ from fedcrack_tpu.fed.serialization import (  # noqa: F401
 )
 from fedcrack_tpu.fed.rounds import (  # noqa: F401
     ServerState,
+    decode_and_validate_update,
     initial_state,
+    quorum_target,
     transition,
 )

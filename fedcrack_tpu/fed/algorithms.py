@@ -92,6 +92,35 @@ def fedavg(updates: Sequence[Any], weights: Sequence[float] | None = None) -> An
     return jax.tree_util.tree_map(avg_leaf, *updates)
 
 
+def sample_cohort(
+    n_clients: int,
+    cohort_size: int,
+    round_idx: int,
+    seed: int = 0,
+) -> np.ndarray:
+    """The round's cohort: a seeded, sorted, without-replacement sample of
+    ``cohort_size`` client indices from the ``n_clients`` population
+    (round 13 — cross-device FL samples a fresh cohort per round instead
+    of training every client every round; Bonawitz et al., MLSys 2019).
+
+    Determinism contract (property-pinned in tests/test_fed.py): the draw
+    is a pure function of ``(seed, round_idx)`` — the whole multi-round
+    cohort SEQUENCE reproduces from one seed, independent of call order or
+    prior draws (each round seeds a fresh ``SeedSequence([seed,
+    round_idx])``; no shared RNG state to advance). Sorted output keeps
+    downstream group packing / edge partitioning deterministic too.
+    """
+    if n_clients <= 0:
+        raise ValueError(f"n_clients must be positive, got {n_clients}")
+    if not 0 < cohort_size <= n_clients:
+        raise ValueError(
+            f"cohort_size must be in [1, n_clients={n_clients}], got {cohort_size}"
+        )
+    rng = np.random.default_rng(np.random.SeedSequence([int(seed), int(round_idx)]))
+    picks = rng.choice(n_clients, size=cohort_size, replace=False)
+    return np.sort(picks.astype(np.int64))
+
+
 def fedprox_penalty(params: Any, anchor: Any, mu: float) -> jax.Array:
     """(mu/2)||params - anchor||^2 — the FedProx proximal term added to the
     client loss on non-IID shards (BASELINE.md config 4)."""

@@ -253,6 +253,68 @@ def _decoded_round_base(state: "ServerState"):
     return tree
 
 
+def decode_and_validate_update(
+    blob: bytes,
+    num_samples: int,
+    *,
+    template: Any,
+    base_fn,
+    base_version: int,
+    sanitize: bool,
+) -> tuple[bytes, int, str, str | None]:
+    """THE upload acceptance gate, shared by every aggregation tier
+    (round 13): the root's ``transition`` and the edge aggregators in
+    :mod:`fedcrack_tpu.fed.tree` route every ``TrainDone`` payload through
+    this one function, so "every tier sanitizes identically" is a property
+    of the code shape, not of parallel maintenance.
+
+    A framed (compressed) upload is CRC-checked, base-version-pinned,
+    reconstructed against ``base_fn()`` (the decoded broadcast tree — a
+    callable so callers keep their decode memo), and its reconstruction
+    validated; frames are ALWAYS sanitized regardless of ``sanitize``
+    (corrupt compressed bytes are the codec subsystem's own failure
+    surface, and a CRC-valid frame can still carry a poisoned trainer's
+    NaNs). A raw blob is validated when ``sanitize`` is on.
+
+    Returns ``(decoded_blob, wire_len, codec_name, problem)`` — ``problem``
+    is the rejection reason (never aggregate) or None; on acceptance
+    ``decoded_blob`` is the full-tree msgpack bytes (re-serialized for a
+    frame, the original bytes for a raw upload).
+    """
+    wire_len = len(blob)
+    codec_name = "null"
+    problem = None
+    if wire_frames.is_frame(blob):
+        if template is None:
+            problem = "compressed frame rejected: server has no decode template"
+        else:
+            try:
+                tree, frame = wire_frames.decode_update(
+                    blob,
+                    template=template,
+                    base=base_fn(),
+                    expected_base_version=base_version,
+                )
+            except ValueError as e:
+                problem = f"compressed frame rejected: {e}"
+            else:
+                codec_name = frame.codec
+                # Validate the materialized tree directly (no redundant
+                # encode∘decode round-trip per upload); serialize once,
+                # for storage, only on accept.
+                problem = validate_update(tree, template)
+                if problem is None:
+                    blob = tree_to_bytes(tree)
+        if problem is None and num_samples < 0:
+            problem = f"negative sample count {num_samples}"
+    elif sanitize:
+        if num_samples < 0:
+            problem = f"negative sample count {num_samples}"
+        elif template is not None:
+            problem = validate_update(blob, template)
+    return blob, wire_len, codec_name, problem
+
+
 def drop_log(state: ServerState, cname: str, title: str) -> ServerState:
     """Forget an accumulated upload (called after the transport flushes it
     to disk, so server memory does not grow with every upload)."""
@@ -308,14 +370,17 @@ def _ready_config(state: ServerState, status: str) -> dict[str, Any]:
     }
 
 
+def quorum_target(quorum_fraction: float, cohort_size: int) -> int:
+    """K of the K-of-N barrier: ceil(quorum_fraction * N), floored at one
+    real update. 1.0 (the default) is the full barrier. The epsilon guards
+    float products like 0.6 * 5 = 3.0000000000000004 from ceiling into an
+    extra required client. Shared by the root round machine and every edge
+    tier of the aggregation tree (fed.tree) — one formula, all tiers."""
+    return max(1, math.ceil(quorum_fraction * cohort_size - 1e-9))
+
+
 def _quorum_target(state: ServerState) -> int:
-    """K of the K-of-N barrier: ceil(quorum_fraction * |cohort|), floored at
-    one real update. 1.0 (the default) is the full barrier. The epsilon
-    guards float products like 0.6 * 5 = 3.0000000000000004 from ceiling
-    into an extra required client."""
-    return max(
-        1, math.ceil(state.config.quorum_fraction * len(state.cohort) - 1e-9)
-    )
+    return quorum_target(state.config.quorum_fraction, len(state.cohort))
 
 
 def _barrier_met(state: ServerState) -> bool:
@@ -625,64 +690,26 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
                         "server_round": state.current_round,
                     },
                 )
-            wire_len = len(blob)
-            codec_name = "null"
-            problem = None
-            if wire_frames.is_frame(blob):
-                # Compressed-update frame (round 12): CRC-check, reconstruct
-                # the full weight tree against the server's CURRENT round
-                # base (the frame's base_version must match — a delta
-                # against any other base would reconstruct garbage weights
-                # that still pass every shape check), then route the
-                # reconstruction through the SAME validate_update sanitation
-                # gate raw uploads take. Frames are always sanitized
-                # regardless of config.sanitize_updates: corrupt compressed
-                # bytes are exactly the new failure surface this subsystem
-                # introduces, and a CRC-valid frame can still carry NaNs
-                # from a poisoned trainer (fedlint COMP001 pins this decode
-                # path to validate_update statically).
-                if state.template is None:
-                    problem = "compressed frame rejected: server has no decode template"
-                else:
-                    try:
-                        # The delta base is the BROADCAST blob — the bytes
-                        # the client actually pulled and subtracted. With
-                        # wire_dtype=bfloat16 that is the bf16-cast wire
-                        # blob, NOT global_blob: decoding against the f32
-                        # global would add (f32_base - bf16(f32_base)) to
-                        # every reconstructed weight — finite, shape-
-                        # correct, silently wrong.
-                        tree, frame = wire_frames.decode_update(
-                            blob,
-                            template=state.template,
-                            base=_decoded_round_base(state),
-                            expected_base_version=state.model_version,
-                        )
-                    except ValueError as e:
-                        problem = f"compressed frame rejected: {e}"
-                    else:
-                        codec_name = frame.codec
-                        # Validate the materialized tree directly (no
-                        # redundant encode∘decode round-trip per upload);
-                        # serialize once, for storage, only on accept.
-                        problem = validate_update(tree, state.template)
-                        if problem is None:
-                            blob = tree_to_bytes(tree)
-                if problem is None and ns < 0:
-                    problem = f"negative sample count {ns}"
-            elif state.config.sanitize_updates:
-                # Deliberate cost note: this decodes the payload once at
-                # receive and _aggregate decodes it again at the barrier —
-                # both inside the single-writer transition, like every other
-                # state-machine step (the machine stays a pure function; the
-                # transport layer stays a dumb adapter). The control plane's
-                # weight blobs are small whenever the TPU data plane carries
-                # the real traffic; an operator who needs multi-GB uploads
-                # sanitized off-thread should gate at the transport instead.
-                if ns < 0:
-                    problem = f"negative sample count {ns}"
-                elif state.template is not None:
-                    problem = validate_update(blob, state.template)
+            # Compressed-frame decode + sanitation (rounds 12/13): the
+            # shared decode_and_validate_update gate. The delta base is the
+            # BROADCAST blob — the bytes the client actually pulled and
+            # subtracted (with wire_dtype=bfloat16 that is the bf16-cast
+            # wire blob, NOT global_blob: decoding against the f32 global
+            # would reconstruct finite, shape-correct, silently-wrong
+            # weights). Cost note for the raw path: the payload decodes
+            # once here and again at the barrier — both inside the
+            # single-writer transition, like every other state-machine
+            # step; an operator who needs multi-GB uploads sanitized
+            # off-thread should gate at the transport instead. fedlint
+            # COMP001 pins the frame decode to validate_update statically.
+            blob, wire_len, codec_name, problem = decode_and_validate_update(
+                blob,
+                ns,
+                template=state.template,
+                base_fn=lambda: _decoded_round_base(state),
+                base_version=state.model_version,
+                sanitize=state.config.sanitize_updates,
+            )
             if problem is not None:
                 # Refused BEFORE it can touch FedAvg; observable in the
                 # round's history entry. The client fails loudly — a

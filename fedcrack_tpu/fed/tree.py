@@ -1,0 +1,499 @@
+"""Hierarchical aggregation tree: edge tiers between the cohort and the root.
+
+A flat coordinator materializes one full weight blob PER CLIENT before it
+can average — O(N) server memory, the hard wall between the reference's
+handful of processes and the ROADMAP's 1,000+-client cohorts. Production FL
+systems (Bonawitz et al., MLSys 2019) interpose an edge tier: each edge
+aggregator owns a shard of the cohort, runs the SAME acceptance gate and
+K-of-N quorum the root runs, reduces its shard to ONE sample-weighted
+partial average, and streams that single blob upward. Root memory drops to
+O(fan-in); total resident blobs at any instant are bounded by one edge's
+leaf fan-in plus the root's edge fan-in.
+
+Exactness: a sample-weighted FedAvg is associative over sample-weighted
+partial FedAvgs — ``fedavg(all leaves, counts) == fedavg(edge partials,
+edge count sums)`` up to float re-association (the edge tier changes the
+summation grouping, like any distributed reduction; the 1,024-client smoke
+pins the tree-vs-flat agreement numerically and the tree's own trajectory
+BITWISE reproducible from the cohort seed).
+
+Every tier routes uploads through the one shared acceptance gate
+(:func:`fedcrack_tpu.fed.rounds.decode_and_validate_update` — CRC'd frame
+decode, shape/finiteness sanitation), every tier takes K-of-N quorum via
+the one shared :func:`fedcrack_tpu.fed.rounds.quorum_target`, and every
+tier persists its in-flight round to an atomic statefile so a mid-round
+kill resumes with the already-received updates intact (the r8 server
+statefile contract, generalized per tier; tools/chaos_drill.py drills the
+edge kill→restart). The edge→root hop can re-encode the partial with the
+r12 codecs (``update_codec``) — partial aggregates are deltas against the
+same broadcast base the leaves trained from, so the root's existing frame
+decode accepts them unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import logging
+from typing import Any, Callable, Sequence
+
+import msgpack
+import numpy as np
+
+from fedcrack_tpu.configs import FedConfig
+from fedcrack_tpu.fed import rounds as R
+from fedcrack_tpu.fed.algorithms import fedavg, sample_cohort
+from fedcrack_tpu.fed.rounds import decode_and_validate_update, quorum_target
+from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
+from fedcrack_tpu.ioutils import atomic_write_bytes
+
+log = logging.getLogger("fedcrack.fed.tree")
+
+EDGE_STATE_FORMAT = 1
+
+
+def partition_cohort(cohort: Sequence[int], n_edges: int) -> list[np.ndarray]:
+    """Deterministic contiguous split of a (sorted) cohort across
+    ``n_edges`` edge aggregators — ``np.array_split`` semantics (the first
+    ``len % n_edges`` edges take one extra leaf). Deterministic assignment
+    is part of the bit-reproducibility contract: the same cohort always
+    lands on the same edges, so each edge's partial average reproduces."""
+    if n_edges <= 0:
+        raise ValueError(f"n_edges must be positive, got {n_edges}")
+    arr = np.asarray(cohort, np.int64)
+    if arr.size == 0:
+        raise ValueError("empty cohort")
+    return [s for s in np.array_split(arr, min(n_edges, arr.size))]
+
+
+class EdgeAggregator:
+    """One edge tier node: collects its leaf shard's updates for the
+    current round, sanitizes each through the shared acceptance gate,
+    holds at most LEAF-FAN-IN decoded blobs, and reduces them to one
+    sample-weighted partial average for the hop up.
+
+    The edge deliberately does NOT advance a round counter or broadcast —
+    it is a reducer, not a coordinator: the round/base it aggregates for
+    comes down from the root (``begin_round``), and what its leaves train
+    on next is the ROOT's next broadcast, never the edge's partial (an
+    edge that broadcast its own partial would fork the federation's
+    trajectory per shard).
+
+    ``state_path`` arms per-tier crash recovery: every accepted or
+    rejected offer snapshots the in-flight round through the same atomic
+    write-temp + fsync + rename discipline as the server statefile, and
+    :meth:`restore` resumes the SAME round with the already-received
+    updates intact (drilled by tools/chaos_drill.py EDGE_AGGREGATOR_CRASH).
+    """
+
+    def __init__(
+        self,
+        edge_id: str,
+        template: Any,
+        *,
+        quorum_fraction: float = 1.0,
+        sanitize: bool = True,
+        state_path: str = "",
+        update_codec: str = "null",
+        topk_fraction: float = 0.01,
+    ):
+        if not 0.0 < quorum_fraction <= 1.0:
+            raise ValueError(
+                f"quorum_fraction must be in (0, 1], got {quorum_fraction}"
+            )
+        if update_codec not in ("null", "int8", "topk_delta"):
+            raise ValueError(f"unknown update_codec {update_codec!r}")
+        self.edge_id = edge_id
+        self.template = template
+        self.quorum_fraction = quorum_fraction
+        self.sanitize = sanitize
+        self.state_path = state_path
+        self.update_codec = update_codec
+        self.topk_fraction = topk_fraction
+        self.round = 0
+        self.base_version = -1
+        self.base_blob = b""
+        self.leaves: frozenset[str] = frozenset()
+        self.received: dict[str, tuple[bytes, int]] = {}
+        self.rejected: dict[str, str] = {}
+        self.wire_bytes: dict[str, int] = {}
+        # Observability the cohort-scale decision point reads: the most
+        # decoded update blobs this edge ever held at once (must stay
+        # <= leaf fan-in) and the wire bytes in/up.
+        self.peak_resident_blobs = 0
+        self.bytes_in = 0
+        self.bytes_up = 0
+        self._base_tree = None
+        # One codec instance for the edge's LIFETIME, like the leaf
+        # FedClient's: topk_delta's error-feedback residual is cross-round
+        # state — a per-round codec would silently drop each round's
+        # unsent partial-delta mass forever instead of re-entering it.
+        self._codec = None
+
+    # -- round lifecycle --
+
+    def begin_round(
+        self,
+        round_idx: int,
+        base_blob: bytes,
+        base_version: int,
+        leaves: Sequence[Any],
+    ) -> None:
+        """Arm the edge for one root round: the shard of leaf names it is
+        responsible for, and the root's broadcast base (the blob its
+        leaves pulled — framed leaf deltas decode against it)."""
+        self.round = int(round_idx)
+        self.base_blob = bytes(base_blob)
+        self.base_version = int(base_version)
+        self.leaves = frozenset(str(x) for x in leaves)
+        if not self.leaves:
+            raise ValueError(f"edge {self.edge_id}: empty leaf shard")
+        self.received = {}
+        self.rejected = {}
+        self.wire_bytes = {}
+        self._base_tree = None
+        self._persist()
+
+    def _decoded_base(self):
+        if self._base_tree is None:
+            self._base_tree = tree_from_bytes(self.base_blob, template=self.template)
+        return self._base_tree
+
+    @property
+    def quorum(self) -> int:
+        return quorum_target(self.quorum_fraction, len(self.leaves))
+
+    def quorum_met(self) -> bool:
+        return len(self.received) >= self.quorum
+
+    def offer(self, cname: str, blob: bytes, num_samples: int) -> tuple[bool, str | None]:
+        """One leaf's upload. Routes through the SAME
+        ``decode_and_validate_update`` gate the root runs — a corrupt
+        frame, wrong-shape tree or NaN update is rejected (recorded, never
+        averaged) at the edge, before it can cost a hop up. Returns
+        ``(accepted, rejection_reason)``."""
+        if cname not in self.leaves:
+            return False, f"{cname} not in this edge's shard"
+        if cname in self.received:
+            return False, f"duplicate upload from {cname}"
+        decoded, wire_len, _codec, problem = decode_and_validate_update(
+            blob,
+            num_samples,
+            template=self.template,
+            base_fn=self._decoded_base,
+            base_version=self.base_version,
+            sanitize=self.sanitize,
+        )
+        self.bytes_in += wire_len
+        if problem is not None:
+            self.rejected[cname] = problem
+            self._persist()
+            return False, problem
+        self.received[cname] = (decoded, int(num_samples))
+        self.wire_bytes[cname] = wire_len
+        self.peak_resident_blobs = max(self.peak_resident_blobs, len(self.received))
+        self._persist()
+        return True, None
+
+    def partial(self) -> tuple[bytes, int]:
+        """The shard's sample-weighted partial FedAvg as ONE upload for the
+        parent tier: ``(blob_or_frame, total_samples)``. Weighting partials
+        by their sample SUM is what makes the tree reduce to the flat
+        sample-weighted mean (weighted-mean associativity). With a non-null
+        ``update_codec`` the partial re-encodes as a delta frame against
+        the round base — the r12 wire contract, so the parent's existing
+        frame decode + sanitation accepts it unchanged."""
+        if not self.received:
+            raise RuntimeError(
+                f"edge {self.edge_id}: no accepted updates to aggregate"
+            )
+        names = sorted(self.received)
+        trees = [
+            tree_from_bytes(self.received[n][0], template=self.template)
+            for n in names
+        ]
+        counts = [self.received[n][1] for n in names]
+        weights = counts if any(c > 0 for c in counts) else None
+        avg = fedavg(trees, weights)
+        total = int(sum(counts))
+        blob = tree_to_bytes(avg)
+        if self.update_codec != "null":
+            if self._codec is None:
+                from fedcrack_tpu.compress import get_codec
+
+                self._codec = get_codec(
+                    self.update_codec,
+                    topk_fraction=self.topk_fraction,
+                    client_tag=self.edge_id,
+                )
+            blob = self._codec.encode_update(
+                blob,
+                self.base_blob,
+                round=self.round,
+                base_version=self.base_version,
+            )
+        self.bytes_up += len(blob)
+        return blob, total
+
+    def end_round(self) -> None:
+        """Release the round's decoded blobs (the fan-in memory bound is a
+        per-round guarantee, not a leak) once the partial is safely up."""
+        self.received = {}
+        self.wire_bytes = {}
+        self._base_tree = None
+        self._persist()
+
+    # -- per-tier durable state (the r8 statefile contract, edge-shaped) --
+
+    def _persist(self) -> None:
+        if not self.state_path:
+            return
+        payload = {
+            "format": EDGE_STATE_FORMAT,
+            "edge_id": self.edge_id,
+            "round": self.round,
+            "base_version": self.base_version,
+            "base_blob": self.base_blob,
+            "leaves": sorted(self.leaves),
+            # Sorted, like the server statefile: snapshot bytes are a pure
+            # function of state, not of upload arrival order.
+            "received": {
+                name: [blob, int(ns)]
+                for name, (blob, ns) in sorted(self.received.items())
+            },
+            "rejected": {k: v for k, v in sorted(self.rejected.items())},
+            "wire_bytes": {k: int(v) for k, v in sorted(self.wire_bytes.items())},
+        }
+        atomic_write_bytes(self.state_path, msgpack.packb(payload, use_bin_type=True))
+
+    @classmethod
+    def restore(
+        cls,
+        state_path: str,
+        template: Any,
+        *,
+        quorum_fraction: float = 1.0,
+        sanitize: bool = True,
+        update_codec: str = "null",
+        topk_fraction: float = 0.01,
+    ) -> "EdgeAggregator | None":
+        """Resume a killed edge from its statefile: same round, same base,
+        already-received updates intact. None when the file is missing or
+        unreadable (the restarted edge then begins the round fresh and the
+        root's quorum/deadline machinery absorbs the loss)."""
+        try:
+            with open(state_path, "rb") as f:
+                blob = f.read()
+        except FileNotFoundError:
+            return None
+        except OSError:
+            log.exception("edge statefile %s unreadable", state_path)
+            return None
+        try:
+            payload = msgpack.unpackb(blob, raw=False)
+            if payload.get("format") != EDGE_STATE_FORMAT:
+                raise ValueError(f"unknown edge statefile format {payload.get('format')!r}")
+            edge = cls(
+                str(payload["edge_id"]),
+                template,
+                quorum_fraction=quorum_fraction,
+                sanitize=sanitize,
+                state_path=state_path,
+                update_codec=update_codec,
+                topk_fraction=topk_fraction,
+            )
+            edge.round = int(payload["round"])
+            edge.base_version = int(payload["base_version"])
+            edge.base_blob = bytes(payload["base_blob"])
+            edge.leaves = frozenset(str(x) for x in payload["leaves"])
+            edge.received = {
+                name: (bytes(pair[0]), int(pair[1]))
+                for name, pair in payload["received"].items()
+            }
+            edge.rejected = dict(payload.get("rejected", {}))
+            edge.wire_bytes = {
+                k: int(v) for k, v in payload.get("wire_bytes", {}).items()
+            }
+            edge.peak_resident_blobs = len(edge.received)
+            return edge
+        except Exception:
+            log.exception("edge statefile %s corrupt; starting fresh", state_path)
+            return None
+
+
+@dataclasses.dataclass
+class TreeRunResult:
+    """What :func:`run_tree_federation` proves, in numbers."""
+
+    state: Any                      # final root ServerState
+    n_clients: int
+    cohort_size: int
+    n_edges: int
+    rounds: int
+    root_peak_blobs: int            # max |root.received| — must be <= n_edges
+    edge_peak_blobs: int            # max over edges — must be <= leaf fan-in
+    max_leaf_fan_in: int
+    leaf_updates: int               # total leaf uploads offered
+    leaf_rejections: int
+    bytes_at_root: int              # wire bytes the root actually received
+    bytes_flat_equiv: int           # what a flat root would have received
+    global_sha256: str              # fingerprint of the final global blob
+    cohorts: list[list[int]]        # per-round sampled cohorts (seeded)
+
+
+def run_tree_federation(
+    variables: Any,
+    make_update: Callable[[int, int, bytes, int], tuple[bytes, int]],
+    *,
+    n_clients: int,
+    cohort_size: int,
+    n_rounds: int,
+    n_edges: int,
+    cohort_seed: int = 0,
+    quorum_fraction: float = 1.0,
+    edge_quorum_fraction: float = 1.0,
+    update_codec: str = "null",
+    topk_fraction: float = 0.01,
+    sanitize: bool = True,
+    state_dir: str = "",
+) -> TreeRunResult:
+    """Drive a multi-round federation through a 2-level aggregation tree,
+    in-process: the ROOT is the unmodified round state machine
+    (``fed.rounds.transition`` — its cohort is the EDGES), each edge an
+    :class:`EdgeAggregator` over its shard of the per-round seeded cohort,
+    each leaf a simulated client (``make_update(client_idx, round_idx,
+    base_blob, base_version) -> (blob, n_samples)``).
+
+    Edges process their shards SEQUENTIALLY and release their decoded
+    blobs after the hop up, so peak resident update blobs anywhere in the
+    process are ``max(leaf fan-in) + root fan-in`` — the memory shape that
+    makes a 1,024-simulated-client round run where a flat coordinator
+    would hold 1,024 decoded models. Every quantity the cohort-scale
+    decision point reads comes back in :class:`TreeRunResult`.
+
+    Bit-reproducibility: with a deterministic ``make_update``, the entire
+    trajectory — cohorts, shard assignment, every edge partial, the root
+    average — is a pure function of ``cohort_seed`` (test-pinned via
+    ``global_sha256``).
+    """
+    import os
+
+    if cohort_size < n_edges:
+        # partition_cohort would hand out fewer shards than edges and the
+        # root's full barrier over n_edges could never close — a
+        # misconfiguration, surfaced here instead of as an IndexError
+        # mid-round.
+        raise ValueError(
+            f"cohort_size={cohort_size} < n_edges={n_edges}: every edge "
+            "needs at least one leaf (shrink the tree's fan-out)"
+        )
+    cfg = FedConfig(
+        max_rounds=n_rounds,
+        cohort_size=n_edges,
+        quorum_fraction=quorum_fraction,
+        sanitize_updates=sanitize,
+        registration_window_s=3600.0,
+        update_codec=update_codec,
+        topk_fraction=topk_fraction,
+    )
+    state = R.initial_state(cfg, variables)
+    now = 0.0
+    for e in range(n_edges):
+        now += 1e-3
+        state, rep = R.transition(state, R.Ready(cname=f"edge-{e}", now=now))
+        assert rep.status == R.SW, rep.status
+    assert state.phase == R.PHASE_RUNNING
+
+    edges = [
+        EdgeAggregator(
+            f"edge-{e}",
+            state.template,
+            quorum_fraction=edge_quorum_fraction,
+            sanitize=sanitize,
+            state_path=(
+                os.path.join(state_dir, f"edge-{e}.msgpack") if state_dir else ""
+            ),
+            update_codec=update_codec,
+            topk_fraction=topk_fraction,
+        )
+        for e in range(n_edges)
+    ]
+
+    root_peak = 0
+    edge_peak = 0
+    max_fan_in = 0
+    leaf_updates = 0
+    leaf_rejections = 0
+    bytes_at_root = 0
+    bytes_flat = 0
+    cohorts: list[list[int]] = []
+
+    for r in range(n_rounds):
+        round_no = state.current_round
+        base_blob = state.broadcast_blob
+        base_version = state.model_version
+        cohort = sample_cohort(n_clients, cohort_size, r, cohort_seed)
+        cohorts.append([int(x) for x in cohort])
+        shards = partition_cohort(cohort, n_edges)
+        for e, edge in enumerate(edges):
+            shard = [f"client-{int(i)}" for i in shards[e]]
+            max_fan_in = max(max_fan_in, len(shard))
+            edge.begin_round(round_no, base_blob, base_version, shard)
+            for idx, name in zip(shards[e], shard):
+                blob, ns = make_update(int(idx), r, base_blob, base_version)
+                leaf_updates += 1
+                bytes_flat += len(blob)
+                accepted, _reason = edge.offer(name, blob, ns)
+                if not accepted:
+                    leaf_rejections += 1
+            edge_peak = max(edge_peak, edge.peak_resident_blobs)
+            if not edge.quorum_met():
+                # The root's deadline machinery would shrink around a
+                # silent edge in a live deployment; the in-process harness
+                # surfaces it instead of stalling.
+                raise RuntimeError(
+                    f"edge-{e} missed quorum round {round_no}: "
+                    f"{len(edge.received)}/{edge.quorum}"
+                )
+            partial_blob, total = edge.partial()
+            bytes_at_root += len(partial_blob)
+            now += 1e-3
+            state, rep = R.transition(
+                state,
+                R.TrainDone(
+                    cname=edge.edge_id,
+                    round=round_no,
+                    blob=partial_blob,
+                    num_samples=total,
+                    now=now,
+                ),
+            )
+            if rep.status == R.REJECTED:
+                raise RuntimeError(
+                    f"root rejected edge-{e}'s partial: {rep.config}"
+                )
+            # The reply that closed the barrier already emptied `received`;
+            # the pre-aggregation peak is then the number of edges that had
+            # reported (e + 1).
+            closed = rep.status in (R.RESP_ARY, R.FIN)
+            root_peak = max(root_peak, e + 1 if closed else len(state.received))
+            edge.end_round()
+        if state.current_round == round_no:
+            raise RuntimeError(f"root round {round_no} failed to close")
+
+    return TreeRunResult(
+        state=state,
+        n_clients=n_clients,
+        cohort_size=cohort_size,
+        n_edges=n_edges,
+        rounds=n_rounds,
+        root_peak_blobs=root_peak,
+        edge_peak_blobs=edge_peak,
+        max_leaf_fan_in=max_fan_in,
+        leaf_updates=leaf_updates,
+        leaf_rejections=leaf_rejections,
+        bytes_at_root=bytes_at_root,
+        bytes_flat_equiv=bytes_flat,
+        global_sha256=hashlib.sha256(state.global_blob).hexdigest(),
+        cohorts=cohorts,
+    )

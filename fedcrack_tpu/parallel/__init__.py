@@ -13,17 +13,21 @@ from fedcrack_tpu.parallel.mesh import make_mesh  # noqa: F401
 from fedcrack_tpu.parallel.driver import (  # noqa: F401
     RoundRecord,
     resident_pool_fits,
+    run_cohort_federation,
     run_mesh_federation,
     shuffled_epoch_data,
     stage_round_data,
     stage_round_indices,
 )
 from fedcrack_tpu.parallel.fedavg_mesh import (  # noqa: F401
+    CohortRound,
     SegmentedRound,
+    build_federated_cohort_round,
     build_federated_round,
     build_federated_round_segments,
     build_spatial_federated_round,
     mesh_fedavg,
+    pad_cohort_axis,
     stack_client_data,
 )
 from fedcrack_tpu.parallel.multihost import (  # noqa: F401

@@ -77,7 +77,11 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fedcrack_tpu.data.pipeline import SamplePool, split_epoch_slab
-from fedcrack_tpu.parallel.fedavg_mesh import SegmentedRound
+from fedcrack_tpu.parallel.fedavg_mesh import (
+    CohortRound,
+    SegmentedRound,
+    pad_cohort_axis,
+)
 
 CLIENTS, BATCH = "clients", "batch"
 
@@ -984,6 +988,224 @@ def run_mesh_federation(
         else:
             staged_bytes = 0
 
+    return variables, records
+
+
+def _stage_group_slab(images, masks, mesh, spec):
+    """Stage one GROUP's ``[G, steps, B, ...]`` slab pair and barrier."""
+    return stage_round_data(
+        np.ascontiguousarray(images), np.ascontiguousarray(masks), mesh, spec
+    )
+
+
+def _stage_group_resident(pool_i, pool_m, idx, mesh):
+    """Stage one group's resident pool slice (sharded ``P('clients')``)
+    plus its full-round gather plan, barriered."""
+    sharding = NamedSharding(mesh, P(CLIENTS))
+    si = jax.device_put(np.ascontiguousarray(pool_i), sharding)
+    sm = jax.device_put(np.ascontiguousarray(pool_m), sharding)
+    _barrier_read(si)
+    _barrier_read(sm)
+    sx = jax.device_put(
+        np.ascontiguousarray(idx), NamedSharding(mesh, P(CLIENTS, None, None, BATCH))
+    )
+    _barrier_read(sx)
+    return (si, sm), sx
+
+
+def run_cohort_federation(
+    cohort_round: CohortRound,
+    variables: Any,
+    data_fn: Callable[[int], Any],
+    n_rounds: int,
+    mesh: Mesh,
+    *,
+    sample_pool: SamplePool | None = None,
+    image_spec: P | None = None,
+    on_round: Callable[[RoundRecord, Any], None] | None = None,
+) -> tuple[Any, list[RoundRecord]]:
+    """Drive a time-multiplexed cohort federation (round 13): each round's
+    C-client cohort executes as ``ceil(C / G)`` sequential group dispatches
+    over the G-wide mesh, with PER-GROUP staging — group g+1's slab (or
+    resident pool slice + plan) stages while group g's programs run, and
+    group g's buffers are released at its barrier, so peak driver-staged
+    HBM is ~2 group slices regardless of C.
+
+    - ``cohort_round``: a :class:`~fedcrack_tpu.parallel.fedavg_mesh.
+      CohortRound` from ``build_federated_cohort_round``.
+    - ``data_fn(r)``: the round's cohort — streamed: ``(images [C, steps,
+      B, ...], masks, active [C], n_samples [C])`` numpy arrays; resident
+      (``sample_pool`` set): ``(idx [C, epochs, steps, B], active,
+      n_samples)`` where ``idx`` indexes the COHORT-wide ``sample_pool``
+      (the pool's host twin is sliced and staged per group — the r9
+      resident plane at group grain). Cohort sampling composes here: a
+      ``data_fn`` built on :func:`fedcrack_tpu.fed.algorithms.
+      sample_cohort` makes the whole multi-round trajectory reproducible
+      from one seed. Unlike ``run_mesh_federation`` there is no
+      ``None``-reuse contract — every round supplies its cohort (cohorts
+      change per round; that is the point).
+    - ``on_round(record, variables)``: per-round hook, as in
+      :func:`run_mesh_federation`.
+
+    Returns the final global ``variables`` and one :class:`RoundRecord`
+    per round; ``record.segments`` carries the per-GROUP host timeline
+    (``{"group", "dispatch_s", "staging_s", "staged_bytes"}``) — round
+    wall scales ~linearly in the number of group dispatches, the
+    cohort-scale roofline BASELINE.md "Round 13" models.
+    """
+    if n_rounds <= 0:
+        raise ValueError(f"n_rounds must be positive, got {n_rounds}")
+    resident = sample_pool is not None
+    if resident and cohort_round.data_placement != "resident":
+        raise ValueError(
+            "sample_pool given but cohort_round was built streamed — build "
+            "it with data_placement='resident' for the pool/plan contract"
+        )
+    if not resident and cohort_round.data_placement == "resident":
+        raise ValueError(
+            "cohort_round is resident but no sample_pool was given"
+        )
+    spec = image_spec if image_spec is not None else P(CLIENTS, None, BATCH)
+    g = cohort_round.group_size
+    records: list[RoundRecord] = []
+
+    for r in range(n_rounds):
+        td = time.perf_counter()
+        data = data_fn(r)
+        data_s = time.perf_counter() - td
+        if data is None:
+            raise ValueError(f"data_fn({r}) returned None: a cohort round never reuses")
+        t0 = time.perf_counter()
+        if resident:
+            idx, active, n_samples = data
+            idx = np.ascontiguousarray(np.asarray(idx, np.int32))
+            c = idx.shape[0]
+            if sample_pool.n_clients != c:
+                raise ValueError(
+                    f"sample_pool carries {sample_pool.n_clients} clients, "
+                    f"round {r}'s plan {c} — the pool's client axis must "
+                    "align with the cohort"
+                )
+        else:
+            images, masks, active, n_samples = data
+            images = np.asarray(images)
+            masks = np.asarray(masks)
+            c = images.shape[0]
+            cohort_round.seg.validate_data(images)
+        active = np.asarray(active, np.float32)
+        n_samples = np.asarray(n_samples, np.float32)
+        if active.shape[0] != c:
+            raise ValueError(
+                f"cohort data carries {c} clients, mask {active.shape[0]}"
+            )
+        if float(np.sum(active * n_samples)) <= 0.0:
+            raise ValueError(
+                "non-positive total FedAvg weight: every cohort client dropped"
+            )
+        n_groups = cohort_round.n_groups(c)
+        c_pad = n_groups * g
+        active = pad_cohort_axis(active, c_pad)
+        n_samples = pad_cohort_axis(n_samples, c_pad)
+
+        def slice_pad(arr, lo, hi):
+            # Pad ONLY the last group's slice (ragged cohorts): padding the
+            # whole cohort array up front would copy the entire pool/slab
+            # host-side every round — GBs of memcpy for one short group.
+            part = arr[lo:min(hi, c)]
+            return part if part.shape[0] == hi - lo else pad_cohort_axis(part, hi - lo)
+
+        def stage_group(gi):
+            lo, hi = gi * g, (gi + 1) * g
+            ts = time.perf_counter()
+            if resident:
+                pi = slice_pad(sample_pool.images, lo, hi)
+                pm = slice_pad(sample_pool.masks, lo, hi)
+                ix = slice_pad(idx, lo, hi)
+                bufs = _stage_group_resident(pi, pm, ix, mesh)
+                nbytes = int(pi.nbytes + pm.nbytes + ix.nbytes)
+            else:
+                gi_imgs = slice_pad(images, lo, hi)
+                gi_msks = slice_pad(masks, lo, hi)
+                bufs = _stage_group_slab(gi_imgs, gi_msks, mesh, spec)
+                nbytes = int(gi_imgs.nbytes + gi_msks.nbytes)
+            return bufs, nbytes, time.perf_counter() - ts
+
+        sums = cohort_round.zeros(variables)
+        raw_lasts = []
+        timeline: list[dict] = []
+        staged_total = 0
+        staging_total = 0.0
+        live = 0
+        round_max = 0
+        cur, cur_bytes, stage_s = stage_group(0)
+        live = cur_bytes
+        round_max = max(round_max, live)
+        for gi in range(n_groups):
+            lo = gi * g
+            tdp = time.perf_counter()
+            if resident:
+                (pool_dev, idx_dev) = cur
+                sums, raw = cohort_round.run_group(
+                    sums, variables, pool_dev, idx_dev,
+                    active[lo : lo + g], n_samples[lo : lo + g],
+                )
+            else:
+                si, sm = cur
+                sums, raw = cohort_round.run_group(
+                    sums, variables, si, sm,
+                    active[lo : lo + g], n_samples[lo : lo + g],
+                )
+            dispatch_s = time.perf_counter() - tdp
+            entry = {
+                "group": gi,
+                "dispatch_s": round(dispatch_s, 4),
+                "staging_s": round(stage_s, 4),
+                "staged_bytes": cur_bytes,
+            }
+            staged_total += cur_bytes
+            staging_total += stage_s
+            nxt = None
+            if gi + 1 < n_groups:
+                # Next group's transfer rides under this group's compute
+                # (the dispatches above are async; only the staging
+                # barrier blocks the host).
+                nxt, nxt_bytes, stage_s = stage_group(gi + 1)
+                live += nxt_bytes
+                round_max = max(round_max, live)
+            # Group barrier: raw_last depends on every step of every
+            # client in the group, so fetching it proves the staged
+            # buffers are consumed and safe to release.
+            raw = jax.tree_util.tree_map(np.asarray, raw)
+            raw_lasts.append(raw)
+            if resident:
+                _delete_staged(tuple(cur[0]) + (cur[1],))
+            else:
+                _delete_staged(cur)
+            live -= cur_bytes
+            timeline.append(entry)
+            if nxt is not None:
+                cur, cur_bytes = nxt, nxt_bytes
+        out_vars, metrics = cohort_round.finish(
+            sums, variables, raw_lasts, active, c
+        )
+        metrics_host = jax.tree_util.tree_map(np.asarray, metrics)
+        variables = out_vars
+        wall = time.perf_counter() - t0
+        record = RoundRecord(
+            round_idx=r,
+            metrics=metrics_host,
+            wall_clock_s=wall,
+            data_fn_s=data_s,
+            staging_s=staging_total,
+            staged_bytes=staged_total,
+            overlapped=n_groups > 1,
+            segments=tuple(timeline),
+            max_live_staged_bytes=round_max,
+            data_placement="resident" if resident else "streamed",
+        )
+        records.append(record)
+        if on_round is not None:
+            on_round(record, variables)
     return variables, records
 
 

@@ -51,15 +51,66 @@ from fedcrack_tpu.train.local import make_optimizer
 CLIENTS, BATCH = "clients", "batch"
 
 
-def _masked_mean_over_clients(tree: Any, weight: jax.Array, denom: jax.Array) -> Any:
-    """Weighted psum-mean over the ``clients`` axis; ``weight`` is this
-    client's ``active * n_samples`` (0 for dropped clients)."""
+def _ordered_cohort_sums(tree: Any, weight: jax.Array, init: tuple) -> tuple:
+    """Deterministically-ORDERED masked weighted sums over the ``clients``
+    axis, continuing the partial-sum carry ``init = (num_tree_f32,
+    den_scalar_f32)``: each leaf is all_gathered and left-folded into the
+    carry one client at a time, in client-index order.
 
-    def leaf(x):
-        acc = lax.psum(weight * x.astype(jnp.float32), CLIENTS) / denom
-        return acc.astype(x.dtype)
+    Why not ``lax.psum``: an all-reduce's float addition order is
+    backend/topology-defined (CPU XLA reduces rank-sequentially, a TPU ring
+    reduces in ring order), so group-partial psums do NOT compose bitwise —
+    ``psum_4(x) != psum_2(x[:2]) + psum_2(x[2:])`` (measured). The fold
+    pins ONE expression tree — ``(((0 + w0*x0) + w1*x1) + ...)`` — that is
+    identical whether the cohort runs as one C-wide mesh or as sequential
+    groups of G continuing the carry (round 13's time-multiplexed cohort
+    contract, test-pinned bitwise for groups in {1, 2, 4}). Zero-weight
+    padding clients contribute ``±0.0``, which is a bitwise no-op on any
+    partial sum reachable from the ``+0.0`` init, so ragged cohorts pad
+    clean. Cost vs psum: an all_gather (G x leaf bytes on the ICI) plus a
+    serial length-G fold — noise next to the round's epochs x steps scan.
+    """
+    num, den = init
+    gathered = jax.tree_util.tree_map(
+        lambda x: lax.all_gather(weight * x.astype(jnp.float32), CLIENTS), tree
+    )
+    gw = lax.all_gather(weight, CLIENTS)
 
-    return jax.tree_util.tree_map(leaf, tree)
+    def body(i, acc):
+        acc_num, acc_den = acc
+        acc_num = jax.tree_util.tree_map(
+            lambda a, g: a + g[i], acc_num, gathered
+        )
+        return acc_num, acc_den + gw[i]
+
+    return lax.fori_loop(0, gw.shape[0], body, (num, den))
+
+
+def _zero_sums_like(tree: Any) -> tuple:
+    """The fold's identity carry: f32 zeros per update leaf + a 0 weight."""
+    return (
+        jax.tree_util.tree_map(
+            lambda x: jnp.zeros(jnp.shape(x), jnp.float32), tree
+        ),
+        jnp.zeros((), jnp.float32),
+    )
+
+
+def _finish_cohort_mean(num: Any, total_w: jax.Array, fallback: Any) -> Any:
+    """Divide the ordered sums into the FedAvg mean, with the empty-cohort
+    guard: zero total weight returns ``fallback`` (the round's incoming
+    global model) unchanged. Elementwise ops only — bitwise deterministic
+    regardless of which program (in-round tail, grouped finalize) runs it."""
+    denom = jnp.maximum(total_w, 1e-9)
+    averaged = jax.tree_util.tree_map(
+        lambda s, orig: (s / denom).astype(orig.dtype), num, fallback
+    )
+    keep = total_w > 0.0
+    return jax.tree_util.tree_map(
+        lambda avg, orig: jnp.where(keep, avg, orig.astype(avg.dtype)),
+        averaged,
+        fallback,
+    )
 
 
 def _host_view(x) -> np.ndarray | None:
@@ -202,23 +253,18 @@ def _epoch_runner(tx, apply_fn, inner_axis, n_inner, anchor, mu_arr, pw_arr):
 def _aggregate_and_guard(
     params, batch_stats, fallback_params, fallback_stats, active_i, n_i
 ):
-    """Masked sample-weighted FedAvg over the clients axis (ICI psum), with
-    the in-mesh empty-cohort guard: when every client dropped out the masked
-    mean is all-zeros — return the round's incoming global model unchanged
-    instead. Shared by the monolithic round's tail and the segmented
-    variant's finalize program (same ops, same order)."""
+    """Masked sample-weighted FedAvg over the clients axis, with the in-mesh
+    empty-cohort guard: when every client dropped out return the round's
+    incoming global model unchanged instead of an all-zero mean. Shared by
+    the monolithic round's tail and the segmented variant's finalize program
+    (same ops, same order). Round 13: the reduction is the ORDERED client
+    fold (``_ordered_cohort_sums``), not a psum, so a time-multiplexed
+    cohort accumulating group partials reproduces this tail bitwise."""
     w = active_i * n_i
-    total_w = lax.psum(w, CLIENTS)
-    denom = jnp.maximum(total_w, 1e-9)
-    averaged = {
-        "params": _masked_mean_over_clients(params, w, denom),
-        "batch_stats": _masked_mean_over_clients(batch_stats, w, denom),
-    }
-    keep = total_w > 0.0
-    return jax.tree_util.tree_map(
-        lambda avg, orig: jnp.where(keep, avg, orig.astype(avg.dtype)),
-        averaged,
-        {"params": fallback_params, "batch_stats": fallback_stats},
+    update = {"params": params, "batch_stats": batch_stats}
+    num, total_w = _ordered_cohort_sums(update, w, _zero_sums_like(update))
+    return _finish_cohort_mean(
+        num, total_w, {"params": fallback_params, "batch_stats": fallback_stats}
     )
 
 
@@ -1059,6 +1105,288 @@ def build_federated_round_segments(
         pos_weight=pos_weight,
         remat=remat,
         segments=segments,
+        data_placement=data_placement,
+    )
+
+
+def pad_cohort_axis(arr: np.ndarray, c_pad: int) -> np.ndarray:
+    """Zero-pad the leading (cohort) axis of a per-client array to
+    ``c_pad`` entries. Padding clients ride with ``active = 0`` /
+    ``n_samples = 0``, so their weighted contribution to the ordered fold
+    is ``±0.0`` — a bitwise no-op (see ``_ordered_cohort_sums``)."""
+    arr = np.asarray(arr)
+    c = arr.shape[0]
+    if c >= c_pad:
+        return arr
+    pad = np.zeros((c_pad - c,) + arr.shape[1:], arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+@dataclasses.dataclass(frozen=True)
+class CohortRound:
+    """A time-multiplexed federated round: a cohort of C clients executed
+    as ``ceil(C / G)`` SEQUENTIAL groups of ``G = mesh.shape['clients']``
+    over the same mesh, with a device-resident partial-aggregate carry.
+
+    The chip count bounds how many clients one mesh program can train at
+    once; production cohorts are far larger (ROADMAP "Cohort scale: 8 →
+    1,000+"). This round keeps the per-group training programs exactly the
+    segmented round's (``_build_round_segments`` — same ``_epoch_runner``
+    closure, same carry contract) and splits ONLY the aggregation: each
+    group's ``partial`` program folds its clients' weighted updates into a
+    replicated ``(num_tree, total_weight)`` carry via the ordered client
+    fold, and one ``finish`` program divides + guards at the end.
+
+    Byte-exactness contract (test-pinned for groups in {1, 2, 4}, with
+    segments > 0): the final global weights AND the per-client metrics are
+    bit-identical to the single-group mesh round over the same C-wide
+    cohort whenever C fits the chip count — the ordered fold is ONE
+    expression tree regardless of the group split (``_ordered_cohort_sums``
+    explains why a psum could never give this), per-client local fits are
+    mesh-width-independent, and metrics carry no cross-client reduction.
+    Cohorts not divisible by G pad the last group with inactive zero-weight
+    clients (bitwise no-ops in the fold, sliced out of the metrics).
+
+    Calling the object is round_fn-compatible over FULL-COHORT arrays
+    (``(variables, images [C, ...], masks, active [C], n_samples [C])``,
+    or the resident pool/plan contract); ``parallel.driver.
+    run_cohort_federation`` instead drives ``zeros``/``run_group``/
+    ``finish`` itself so each group's slab (or resident pool slice) can
+    stage right before its dispatch and release right after — peak staged
+    HBM is ~2 GROUP slices, never the C-wide cohort.
+
+    Update-codec twins are monolithic-only (same precedent as the
+    segmented builder); the cohort round has no codec arg.
+    """
+
+    group_size: int
+    n_segments: int
+    segment_epochs: int
+    local_epochs: int
+    n_inner: int
+    seg: SegmentedRound = dataclasses.field(repr=False)
+    partial_fn: Callable = dataclasses.field(repr=False)
+    zeros_fn: Callable = dataclasses.field(repr=False)
+    finish_fn: Callable = dataclasses.field(repr=False)
+    data_placement: str = "streamed"
+
+    def n_groups(self, cohort_size: int) -> int:
+        if cohort_size <= 0:
+            raise ValueError(f"cohort_size must be positive, got {cohort_size}")
+        return -(-cohort_size // self.group_size)
+
+    def zeros(self, variables):
+        """The round's initial partial-aggregate carry (f32 zeros),
+        replicated on the mesh so every group program reads it in-place."""
+        return self.zeros_fn(variables)
+
+    def run_group(self, sums, variables, data_a, data_b, active_g, n_g):
+        """Train ONE group of G clients (init → ``n_segments`` segment
+        programs) and fold its weighted updates into the partial-aggregate
+        carry. Streamed: ``data_a``/``data_b`` are the group's ``[G, steps,
+        B, ...]`` slab pair; resident: the ``(pool_images, pool_masks)``
+        pair and the group's ``[G, local_epochs, steps, B]`` plan. Returns
+        ``(sums', raw_last)`` where ``raw_last`` is the group's last-epoch
+        metric counts ([G] leaves). An all-inactive group (pure padding)
+        is legal and leaves ``sums`` bitwise unchanged."""
+        carry = self.seg.init(variables)
+        raw_last = None
+        if self.data_placement == "resident":
+            se = self.segment_epochs
+            for k in range(self.n_segments):
+                carry, raw_last = self.seg.segment(
+                    carry, variables, data_a, data_b[:, k * se : (k + 1) * se]
+                )
+        else:
+            for _ in range(self.n_segments):
+                carry, raw_last = self.seg.segment(carry, variables, data_a, data_b)
+        sums = self.partial_fn(sums, carry, active_g, n_g)
+        return sums, raw_last
+
+    def finish(self, sums, variables, raw_lasts, active, cohort_size):
+        """Divide the cross-group sums into the new global variables and
+        assemble the per-client metrics from the concatenated group counts
+        (padding lanes sliced off). Same expression tree as the monolithic
+        round's in-program tail — bitwise equal on equal inputs."""
+        new_variables = self.finish_fn(sums, variables)
+        last = jax.tree_util.tree_map(
+            lambda *xs: np.concatenate([np.asarray(x) for x in xs])[:cohort_size],
+            *raw_lasts,
+        )
+        active32 = jnp.asarray(np.asarray(active)[:cohort_size], jnp.float32)
+        metrics = {
+            "loss": jnp.asarray(last["loss"]),
+            "pixel_acc": jnp.asarray(last["pixel_acc"]),
+            "iou": iou_from_counts(
+                jnp.asarray(last["iou_inter"]), jnp.asarray(last["iou_union"])
+            ),
+            "active": active32,
+        }
+        return new_variables, metrics
+
+    def _padded_cohort(self, active, n_samples):
+        active = np.asarray(active, np.float32)
+        n_samples = np.asarray(n_samples, np.float32)
+        c = active.shape[0]
+        c_pad = self.n_groups(c) * self.group_size
+        return (
+            pad_cohort_axis(active, c_pad),
+            pad_cohort_axis(n_samples, c_pad),
+            c,
+            c_pad,
+        )
+
+    def __call__(self, variables, images, masks, active, n_samples):
+        if self.data_placement == "resident":
+            pool, idx = tuple(images), np.asarray(masks, np.int32)
+            c = idx.shape[0]
+            _check_resident_inputs(
+                pool, idx, c, self.local_epochs, self.n_inner,
+                self.seg.validate_data,
+            )
+            _host_cohort_check(active, n_samples)
+            active, n_samples, c, c_pad = self._padded_cohort(active, n_samples)
+            pool_i = pad_cohort_axis(pool[0], c_pad)
+            pool_m = pad_cohort_axis(pool[1], c_pad)
+            idx = pad_cohort_axis(idx, c_pad)
+            sums = self.zeros(variables)
+            raw_lasts = []
+            g = self.group_size
+            for lo in range(0, c_pad, g):
+                sums, raw = self.run_group(
+                    sums,
+                    variables,
+                    (pool_i[lo : lo + g], pool_m[lo : lo + g]),
+                    idx[lo : lo + g],
+                    active[lo : lo + g],
+                    n_samples[lo : lo + g],
+                )
+                raw_lasts.append(raw)
+            return self.finish(sums, variables, raw_lasts, active, c)
+        images = np.asarray(images)
+        masks = np.asarray(masks)
+        if images.shape[0] != np.asarray(active).shape[0]:
+            raise ValueError(
+                f"data carries {images.shape[0]} clients, cohort mask "
+                f"{np.asarray(active).shape[0]}"
+            )
+        self.seg.validate_data(images)
+        _host_cohort_check(active, n_samples)
+        active, n_samples, c, c_pad = self._padded_cohort(active, n_samples)
+        images = pad_cohort_axis(images, c_pad)
+        masks = pad_cohort_axis(masks, c_pad)
+        sums = self.zeros(variables)
+        raw_lasts = []
+        g = self.group_size
+        for lo in range(0, c_pad, g):
+            sums, raw = self.run_group(
+                sums,
+                variables,
+                images[lo : lo + g],
+                masks[lo : lo + g],
+                active[lo : lo + g],
+                n_samples[lo : lo + g],
+            )
+            raw_lasts.append(raw)
+        return self.finish(sums, variables, raw_lasts, active, c)
+
+
+def build_federated_cohort_round(
+    mesh: Mesh,
+    model_config: ModelConfig | None = None,
+    learning_rate: float = 1e-3,
+    local_epochs: int = 1,
+    fedprox_mu: float = 0.0,
+    pos_weight: float = 1.0,
+    remat: bool = False,
+    segments: int = 1,
+    data_placement: str = "streamed",
+) -> CohortRound:
+    """Time-multiplexed cohort variant of :func:`build_federated_round`
+    (round 13): the returned :class:`CohortRound` executes any cohort size
+    as sequential groups of ``mesh.shape['clients']`` with a
+    device-resident partial-aggregate carry — byte-identical to a
+    hypothetical cohort-wide mesh (see the class docstring for the
+    contract and why the aggregation is an ordered fold, not a psum).
+
+    ``segments`` is per GROUP (default 1: one training program per group —
+    grouping already bounds program size); values > 1 stream exactly like
+    :func:`build_federated_round_segments` and must divide
+    ``local_epochs``. ``data_placement="resident"`` takes the pool/plan
+    contract with a COHORT-wide pool, sliced per group
+    (``parallel.driver.run_cohort_federation`` stages each slice right
+    before its group's dispatch).
+    """
+    model_config = model_config or ModelConfig()
+    _require_axes(mesh, CLIENTS, BATCH)
+    apply_fn, validate_channels = _plain_apply_and_validate(model_config)
+    seg = _build_round_segments(
+        mesh,
+        model_config,
+        learning_rate,
+        local_epochs,
+        fedprox_mu,
+        inner_axis=BATCH,
+        apply_fn=apply_fn,
+        image_spec=P(CLIENTS, None, BATCH),
+        validate_data=validate_channels,
+        pos_weight=pos_weight,
+        remat=remat,
+        segments=segments,
+        data_placement=data_placement,
+    )
+
+    def partial_shard(sums, carry, active, n_samples):
+        params, batch_stats, _ = jax.tree_util.tree_map(lambda x: x[0], carry)
+        w = active[0] * n_samples[0]
+        return _ordered_cohort_sums(
+            {"params": params, "batch_stats": batch_stats}, w, sums
+        )
+
+    partial_fn = jax.jit(
+        shard_map(
+            partial_shard,
+            mesh=mesh,
+            in_specs=(P(), P(CLIENTS), P(CLIENTS), P(CLIENTS)),
+            out_specs=P(),
+        )
+    )
+
+    def zeros_fn(variables):
+        update = {
+            "params": variables["params"],
+            "batch_stats": variables["batch_stats"],
+        }
+        zeros = (
+            jax.tree_util.tree_map(
+                lambda t: np.zeros(np.shape(t), np.float32), update
+            ),
+            np.zeros((), np.float32),
+        )
+        return jax.device_put(zeros, NamedSharding(mesh, P()))
+
+    @jax.jit
+    def finish_fn(sums, variables):
+        num, total_w = sums
+        return _finish_cohort_mean(
+            num,
+            total_w,
+            {
+                "params": variables["params"],
+                "batch_stats": variables["batch_stats"],
+            },
+        )
+
+    return CohortRound(
+        group_size=mesh.shape[CLIENTS],
+        n_segments=seg.n_segments,
+        segment_epochs=seg.segment_epochs,
+        local_epochs=seg.local_epochs,
+        n_inner=seg.n_inner,
+        seg=seg,
+        partial_fn=partial_fn,
+        zeros_fn=zeros_fn,
+        finish_fn=finish_fn,
         data_placement=data_placement,
     )
 

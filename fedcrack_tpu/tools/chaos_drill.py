@@ -42,23 +42,11 @@ def _vars(value: float):
 
 
 def _raw_caller(port: int):
-    """One-message-per-call raw client on the shared bidi method."""
-    import grpc
+    """One-message-per-call raw client (transport.edge.raw_caller — the
+    same caller the edge tier's upstream relay is built on)."""
+    from fedcrack_tpu.transport.edge import raw_caller
 
-    from fedcrack_tpu.transport import transport_pb2 as pb
-    from fedcrack_tpu.transport.service import METHOD, SERVICE_NAME
-
-    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
-    method = channel.stream_stream(
-        f"/{SERVICE_NAME}/{METHOD}",
-        request_serializer=pb.ClientMessage.SerializeToString,
-        response_deserializer=pb.ServerMessage.FromString,
-    )
-
-    def call(msg):
-        return next(iter(method(iter([msg]), timeout=10, wait_for_ready=True)))
-
-    return channel, call
+    return raw_caller(port)
 
 
 def _ready(cname: str):
@@ -257,6 +245,117 @@ def run_corrupt_frame_drill() -> dict:
     }
 
 
+def run_edge_crash_drill(workdir: str | None = None) -> dict:
+    """EDGE_AGGREGATOR_CRASH drill (round 13): a 2-edge aggregation tree
+    where one edge tier is KILLED mid-round — after 2 of its 3 leaves
+    reported — and restarted from its statefile. The restarted edge must
+    resume the SAME round with the already-received updates intact, accept
+    the third leaf, close its K-of-N quorum, and push its partial to the
+    root (a real gRPC FedServer) so the root round still closes — with the
+    root average EXACTLY the sample-weighted mean over both edges'
+    partials, and the recovered edge's partial EXACTLY the weighted mean
+    of all three leaves (nothing lost to the crash). The scripted kill is
+    scheduled and recorded through a chaos FaultPlan so the artifact
+    proves the fault actually fired."""
+    from fedcrack_tpu.chaos.plan import EDGE_AGGREGATOR_CRASH, Fault, FaultPlan
+    from fedcrack_tpu.fed.tree import EdgeAggregator
+    from fedcrack_tpu.transport.edge import EdgeRelay
+    from fedcrack_tpu.transport.service import FedServer, ServerThread
+
+    ctx = (
+        tempfile.TemporaryDirectory(prefix="edge_crash_drill_")
+        if workdir is None
+        else None
+    )
+    base = ctx.name if ctx is not None else workdir
+    try:
+        cfg = FedConfig(
+            max_rounds=1,
+            cohort_size=2,  # the ROOT's cohort is the two edges
+            registration_window_s=5.0,
+            round_deadline_s=60.0,
+            port=0,
+        )
+        plan = FaultPlan(
+            [Fault(kind=EDGE_AGGREGATOR_CRASH, round=1, client="edge-0")]
+        )
+        t0 = time.perf_counter()
+        root = FedServer(cfg, _vars(0.0), tick_period_s=0.02)
+        template = root.state.template
+        with ServerThread(root) as st:
+            relay0 = EdgeRelay("edge-0", st.port)
+            relay1 = EdgeRelay("edge-1", st.port)
+            h0 = relay0.enroll()
+            relay1.enroll()
+            base_blob = relay0.pull()
+            base_version = int(h0["model_version"])
+            round_no = int(h0["current_round"])
+
+            state_path = os.path.join(base, "edge-0.msgpack")
+            edge0 = EdgeAggregator(
+                "edge-0", template, quorum_fraction=1.0, state_path=state_path
+            )
+            edge0.begin_round(
+                round_no, base_blob, base_version, ["a", "b", "c"]
+            )
+            assert edge0.offer("a", tree_to_bytes(_vars(1.0)), 10)[0]
+            assert edge0.offer("b", tree_to_bytes(_vars(2.0)), 10)[0]
+            # KILL edge-0 mid-round (leaf c still training): drop the
+            # in-memory aggregator; durable state is whatever the atomic
+            # writer had renamed.
+            assert plan.take(EDGE_AGGREGATOR_CRASH, client="edge-0", round=round_no)
+            t_kill = time.perf_counter()
+            del edge0
+
+            restored = EdgeAggregator.restore(
+                state_path, template, quorum_fraction=1.0
+            )
+            t_restored = time.perf_counter()
+            if restored is None or sorted(restored.received) != ["a", "b"]:
+                raise RuntimeError("edge restart did not resume from its statefile")
+            resumed_mid_round = (
+                restored.round == round_no
+                and restored.base_version == base_version
+            )
+            assert restored.offer("c", tree_to_bytes(_vars(6.0)), 20)[0]
+            assert restored.quorum_met()
+            partial0, total0 = restored.partial()
+            status0, _, _ = relay0.push_partial(round_no, partial0, total0)
+
+            edge1 = EdgeAggregator("edge-1", template, quorum_fraction=1.0)
+            edge1.begin_round(round_no, base_blob, base_version, ["d"])
+            assert edge1.offer("d", tree_to_bytes(_vars(8.0)), 40)[0]
+            partial1, total1 = edge1.partial()
+            status1, new_global, _ = relay1.push_partial(round_no, partial1, total1)
+            t_recovered = time.perf_counter()
+            relay0.close()
+            relay1.close()
+            state = st.state
+        # edge-0's partial: (10*1 + 10*2 + 20*6) / 40 = 3.75 — A and B
+        # restored from disk, C delivered post-restart.
+        p0 = tree_from_bytes(partial0)["params"]["w"]
+        # root: (40*3.75 + 40*8) / 80 = 5.875.
+        got = tree_from_bytes(new_global)["params"]["w"]
+        entry = state.history[0] if state.history else {}
+        return {
+            "fault_fired": [f.kind for f in plan.triggered] == [EDGE_AGGREGATOR_CRASH],
+            "resumed_mid_round": bool(resumed_mid_round),
+            "received_preserved": True,
+            "edge_partial_exact": bool(np.allclose(p0, 3.75, atol=1e-6)),
+            "root_round_closed": status0 == R.RESP_ACY
+            and status1 in (R.RESP_ARY, R.FIN),
+            "root_avg_exact": bool(np.allclose(got, 5.875, atol=1e-6)),
+            "root_clients": entry.get("clients"),
+            "root_cohort_size": entry.get("cohort_size"),
+            "restore_s": round(t_restored - t_kill, 4),
+            "kill_to_recover_s": round(t_recovered - t_kill, 4),
+            "session_s": round(time.perf_counter() - t0, 4),
+        }
+    finally:
+        if ctx is not None:
+            ctx.cleanup()
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--out", required=True)
@@ -266,6 +365,7 @@ def main(argv=None) -> int:
         "generated_by": "fedcrack_tpu.tools.chaos_drill",
         "kill_restart": run_kill_restart_drill(rounds=args.rounds),
         "corrupt_frame": run_corrupt_frame_drill(),
+        "edge_crash": run_edge_crash_drill(),
     }
     os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
     with open(args.out, "w") as f:

@@ -1,0 +1,116 @@
+"""Edge-tier gRPC plumbing: the upstream half of a hierarchical aggregator.
+
+An edge node speaks DOWN to its leaves as an aggregator
+(:class:`fedcrack_tpu.fed.tree.EdgeAggregator`) and UP to the root as an
+ordinary protocol client: it enrolls under its edge id, pulls the round
+base, and reports its shard's partial average as one ``TrainDone`` whose
+``sample_count`` is the shard's sample SUM — the root's existing
+sample-weighted FedAvg then reduces edge partials to exactly the flat
+weighted mean, with no root-side changes. This module is that upstream
+half as a minimal synchronous caller (one message per call on the shared
+bidi method, the reference's own usage pattern); the full
+:class:`fedcrack_tpu.transport.client.FedClient` stays the LEAF driver —
+an edge needs none of its training loop, polling or chaos hooks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from fedcrack_tpu.fed import rounds as R
+from fedcrack_tpu.transport.codec import decode_scalar_map
+
+
+def raw_caller(
+    port: int, host: str = "127.0.0.1", timeout_s: float = 10.0
+) -> tuple[Any, Callable]:
+    """One-message-per-call raw client on the shared bidi method: returns
+    ``(channel, call)`` where ``call(ClientMessage) -> ServerMessage``.
+    The scripted-harness workhorse (tools/chaos_drill, the tree drills) —
+    deterministic, no retry schedule, fails loudly."""
+    import grpc
+
+    from fedcrack_tpu.transport import transport_pb2 as pb
+    from fedcrack_tpu.transport.service import METHOD, SERVICE_NAME
+
+    channel = grpc.insecure_channel(f"{host}:{port}")
+    method = channel.stream_stream(
+        f"/{SERVICE_NAME}/{METHOD}",
+        request_serializer=pb.ClientMessage.SerializeToString,
+        response_deserializer=pb.ServerMessage.FromString,
+    )
+
+    def call(msg):
+        return next(iter(method(iter([msg]), timeout=timeout_s, wait_for_ready=True)))
+
+    return channel, call
+
+
+class EdgeRelay:
+    """The edge→root control-plane session: enroll, pull the round base,
+    push the partial, adopt the root's new global.
+
+    The root sees a cohort of edge ids — quorum, deadline shrink,
+    statefile recovery and update sanitation all apply to edges exactly as
+    they would to clients (the r8 machinery generalizing per tier is the
+    point, not an accident)."""
+
+    def __init__(self, edge_id: str, port: int, host: str = "127.0.0.1",
+                 timeout_s: float = 10.0):
+        self.edge_id = edge_id
+        self._channel, self._call = raw_caller(port, host, timeout_s)
+
+    def _msg(self):
+        from fedcrack_tpu.transport import transport_pb2 as pb
+
+        return pb.ClientMessage(cname=self.edge_id)
+
+    def enroll(self) -> dict:
+        """Register the edge in the root's cohort; returns the handshake
+        config map (current_round / model_version / codec knobs)."""
+        msg = self._msg()
+        msg.ready.SetInParent()
+        rep = self._call(msg)
+        if rep.status != R.SW:
+            raise RuntimeError(
+                f"edge {self.edge_id} not enrolled at root: {rep.status}"
+            )
+        return dict(decode_scalar_map(rep.config))
+
+    def pull(self) -> bytes:
+        """The root's current broadcast blob — the round base this edge's
+        leaves train against and framed deltas decode against."""
+        msg = self._msg()
+        msg.pull.SetInParent()
+        return self._call(msg).weights
+
+    def push_partial(
+        self, round_idx: int, blob: bytes, total_samples: int
+    ) -> tuple[str, bytes, dict]:
+        """Report the shard's partial average for ``round_idx``. Returns
+        ``(status, new_global_blob_or_empty, config)`` — RESP_ARY/FIN carry
+        the root's round average, which the edge adopts as its leaves'
+        next base (never its own partial)."""
+        msg = self._msg()
+        msg.done.round = int(round_idx)
+        msg.done.weights = blob
+        msg.done.sample_count = int(total_samples)
+        rep = self._call(msg)
+        return rep.status, rep.weights, dict(decode_scalar_map(rep.config))
+
+    def poll(self, model_version: int, round_idx: int) -> tuple[str, bytes, dict]:
+        """Version poll against the root (WAIT until the round closes)."""
+        msg = self._msg()
+        msg.poll.model_version = int(model_version)
+        msg.poll.round = int(round_idx)
+        rep = self._call(msg)
+        return rep.status, rep.weights, dict(decode_scalar_map(rep.config))
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def __enter__(self) -> "EdgeRelay":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

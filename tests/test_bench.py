@@ -417,3 +417,47 @@ def test_committed_bench_artifacts_satisfy_schema():
         assert not bad, f"{name}: {bad}"
         checked += 1
     assert checked >= 1, "no bench artifacts found to validate"
+
+
+def test_cohort_scale_schema_guard():
+    """Round-13 cohort_scale arm: declared in DETAIL_SCHEMA, its keys
+    written by bench.py, typed checks enforced, error-arm exempt."""
+    bench = _import_bench()
+    assert "cohort_scale" in bench.DETAIL_SCHEMA
+    assert {"groups", "tree", "flat"} <= set(bench.COHORT_SCALE_SCHEMA)
+    assert {"round_wall_s", "group_dispatches"} <= set(bench.COHORT_GROUP_SCHEMA)
+    with open(bench.__file__) as f:
+        src = f.read()
+    for key in set(bench.COHORT_SCALE_SCHEMA) | set(bench.COHORT_GROUP_SCHEMA):
+        assert f'"{key}"' in src, f"schema key {key!r} never written by bench.py"
+    good = {
+        "cohort_scale": {
+            "groups": {"2": {"round_wall_s": 1.5, "group_dispatches": 2}},
+            "tree": {"root_peak_blobs": 32},
+            "flat": {"root_peak_blobs": 1024},
+        }
+    }
+    assert bench.validate_detail(good) == []
+    # error arm exempt (a failed section still emits a valid artifact)
+    assert bench.validate_detail({"cohort_scale": {"error": "boom"}}) == []
+    # missing required key reported
+    assert any(
+        "cohort_scale['flat'] missing" in v
+        for v in bench.validate_detail(
+            {"cohort_scale": {"groups": {}, "tree": {}}}
+        )
+    )
+    # typed per-group point; a non-dict point is REPORTED, never a crash
+    bad = {
+        "cohort_scale": {
+            "groups": {"2": {"round_wall_s": "slow", "group_dispatches": 2}},
+            "tree": {},
+            "flat": {},
+        }
+    }
+    assert any("round_wall_s" in v for v in bench.validate_detail(bad))
+    bad2 = {"cohort_scale": {"groups": {"2": 42}, "tree": {}, "flat": {}}}
+    assert any("groups['2']" in v for v in bench.validate_detail(bad2))
+    # compact summary lists the section like any other schema section
+    summary = bench.compact_summary({"detail": good})
+    assert "cohort_scale" in summary["sections"]

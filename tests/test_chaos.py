@@ -70,7 +70,14 @@ def cfg():
         cohort_size=2,
         registration_window_s=5.0,
         poll_period_s=0.05,
-        round_deadline_s=0.5,
+        # 2.5 s, not 0.5: the deadline only exists to drop the DEAD client.
+        # At 0.5 s this host's ~0.5-1 s ambient scheduler stalls (2 cores, 8
+        # spin-waiting virtual devices) raced the SURVIVOR's round-trip into
+        # the shrink — the same pathology the r12 flake fix widened
+        # test_transport's dead-client deadline for (reproduced 3/3 under
+        # load at r13; the scenarios that want a deadline that never fires
+        # already override to 30 s).
+        round_deadline_s=2.5,
         host="127.0.0.1",
         port=0,
     )

@@ -427,3 +427,135 @@ def test_fedconfig_data_placement_and_c9_preset():
         preset = FedConfig.from_dict(json.load(f))
     assert preset.data_placement == "resident"
     assert preset.segments == preset.local_epochs == 10
+
+
+# ---------- growable pool: append/evict (round 13 satellite) ----------
+
+
+def _pool_fixture(c=2, n=4, hw=8, seed=0):
+    rng = np.random.default_rng(seed)
+    images = rng.integers(0, 255, size=(c, n, hw, hw, 3), dtype=np.uint8)
+    masks = rng.integers(0, 2, size=(c, n, hw, hw, 1), dtype=np.uint8)
+    return SamplePool(images.copy(), masks.copy()), images, masks
+
+
+def test_pool_append_grows_and_dedups():
+    pool, images, masks = _pool_fixture()
+    assert pool.counts().tolist() == [4, 4]
+    rng = np.random.default_rng(99)
+    fresh_i = rng.integers(0, 255, size=(2, 8, 8, 3), dtype=np.uint8)
+    fresh_m = rng.integers(0, 2, size=(2, 8, 8, 1), dtype=np.uint8)
+    # One genuinely new sample + one byte-duplicate of an existing sample
+    # + one duplicate WITHIN the batch: only the new one (once) lands.
+    batch_i = np.stack([fresh_i[0], images[0, 1], fresh_i[0]])
+    batch_m = np.stack([fresh_m[0], masks[0, 1], fresh_m[0]])
+    kept = pool.append(0, batch_i, batch_m)
+    assert kept == 1
+    assert pool.counts().tolist() == [5, 4]
+    assert pool.n_samples == 5  # capacity grew for ALL clients
+    assert pool.images.shape[1] == pool.masks.shape[1] == 5
+    np.testing.assert_array_equal(pool.images[0, 4], fresh_i[0])
+    # Client 1's new capacity lane is padding outside its valid count.
+    np.testing.assert_array_equal(pool.images[1, 4], 0)
+    # Old samples untouched byte for byte (the host-twin/byte-oracle
+    # contract survives growth).
+    np.testing.assert_array_equal(pool.images[:, :4], images)
+    np.testing.assert_array_equal(pool.masks[:, :4], masks)
+    # Re-appending the same sample is now a no-op.
+    assert pool.append(0, fresh_i[:1], fresh_m[:1]) == 0
+
+
+def test_pool_append_validation():
+    pool, _, _ = _pool_fixture()
+    with pytest.raises(ValueError, match="client"):
+        pool.append(5, np.zeros((1, 8, 8, 3), np.uint8), np.zeros((1, 8, 8, 1), np.uint8))
+    with pytest.raises(ValueError, match="sample shape"):
+        pool.append(0, np.zeros((1, 4, 4, 3), np.uint8), np.zeros((1, 8, 8, 1), np.uint8))
+    with pytest.raises(ValueError, match="disagree"):
+        pool.append(0, np.zeros((2, 8, 8, 3), np.uint8), np.zeros((1, 8, 8, 1), np.uint8))
+
+
+def test_pool_evict_compacts_and_redeups():
+    pool, images, masks = _pool_fixture()
+    assert pool.evict(1, [0, 2]) == 2
+    assert pool.counts().tolist() == [4, 2]
+    assert pool.n_samples == 4  # capacity never shrinks
+    # Survivors compacted to the front IN ORDER.
+    np.testing.assert_array_equal(pool.images[1, 0], images[1, 1])
+    np.testing.assert_array_equal(pool.images[1, 1], images[1, 3])
+    np.testing.assert_array_equal(pool.images[1, 2], 0)
+    # An evicted sample can come back (its digest was dropped).
+    assert pool.append(1, images[1, 0:1], masks[1, 0:1]) == 1
+    assert pool.counts().tolist() == [4, 3]
+    with pytest.raises(ValueError, match="valid range"):
+        pool.evict(1, [3])
+    with pytest.raises(ValueError, match="valid range"):
+        pool.evict(0, [-1])
+
+
+def test_pool_round_indices_respects_valid_counts():
+    pool, images, masks = _pool_fixture(c=2, n=6)
+    pool.evict(0, [4, 5])  # client 0 down to 4 valid samples
+    rngs = [np.random.default_rng(i) for i in range(2)]
+    idx = pool.round_indices(rngs, epochs=1, steps=2, batch_size=2)
+    assert int(idx[0].max()) < 4  # never indexes a retired lane
+    assert int(idx[1].max()) < 6
+    # A round that needs more than the valid count fails loudly.
+    with pytest.raises(ValueError, match="valid samples"):
+        pool.round_indices(
+            [np.random.default_rng(0), np.random.default_rng(1)],
+            epochs=1, steps=3, batch_size=2,
+        )
+
+
+def test_pool_untouched_rng_consumption_unchanged():
+    """Byte-oracle parity retained: an untouched pool draws EXACTLY the
+    pre-growable permutation (permutation over the full pool), so every
+    existing resident==streamed pin keeps holding."""
+    pool, _, _ = _pool_fixture(c=1, n=8)
+    idx = pool.round_indices([np.random.default_rng(5)], epochs=2, steps=2, batch_size=2)
+    want = np.random.default_rng(5).permutation(8)[:4].reshape(2, 2)
+    np.testing.assert_array_equal(idx[0, 0], want)
+    np.testing.assert_array_equal(idx[0, 1], want)  # epoch-tiled
+
+
+def test_pool_append_then_assemble_slab_parity():
+    """assemble_round_slab over a grown pool is still the device gather's
+    byte oracle: pool[idx] on host == take(pool, idx) on device — growth
+    only appends lanes, it never moves existing bytes."""
+    pool, images, masks = _pool_fixture()
+    rng = np.random.default_rng(123)
+    pool.append(
+        0,
+        rng.integers(0, 255, size=(1, 8, 8, 3), dtype=np.uint8),
+        rng.integers(0, 2, size=(1, 8, 8, 1), dtype=np.uint8),
+    )
+    idx = np.broadcast_to(
+        np.array([[[4, 0], [1, 2]], [[3, 0], [1, 2]]], np.int32).reshape(2, 1, 2, 2),
+        (2, 1, 2, 2),
+    )
+    slab_i, slab_m = pool.assemble_round_slab(idx)
+    for c in range(2):
+        np.testing.assert_array_equal(slab_i[c], pool.images[c][idx[c, 0]])
+        np.testing.assert_array_equal(slab_m[c], pool.masks[c][idx[c, 0]])
+
+
+def test_pool_s2d_append_packs_like_ctor():
+    """An s2d pool packs appended samples through the same
+    space_to_depth_images twin the constructor uses — gathering from the
+    grown packed pool stays byte-identical to packing the gathered slab."""
+    from fedcrack_tpu.data.pipeline import space_to_depth_images
+
+    rng = np.random.default_rng(7)
+    images = rng.integers(0, 255, size=(1, 2, 8, 8, 3), dtype=np.uint8)
+    masks = rng.integers(0, 2, size=(1, 2, 8, 8, 1), dtype=np.uint8)
+    pool = SamplePool(images, masks, layout="s2d")
+    extra_i = rng.integers(0, 255, size=(1, 8, 8, 3), dtype=np.uint8)
+    extra_m = rng.integers(0, 2, size=(1, 8, 8, 1), dtype=np.uint8)
+    assert pool.append(0, extra_i, extra_m) == 1
+    np.testing.assert_array_equal(
+        pool.images[0, 2], space_to_depth_images(extra_i)[0]
+    )
+    # Dedup keys on the STORED (packed) canon: the same reference-layout
+    # sample is recognized as a duplicate.
+    assert pool.append(0, extra_i, extra_m) == 0
