@@ -165,6 +165,26 @@ DETAIL_SCHEMA: dict = {
     "serving": dict,
     "update_compression": dict,
     "cohort_scale": dict,
+    "async_federation": dict,
+}
+# Typed keys of detail.async_federation (round 14): the buffered-async
+# contract — the chaos straggler-storm sync-vs-buffered A/B at equal wall,
+# the bit-exact sync-degeneration pin, the mid-buffer kill→restart drill,
+# and the equal-wall trajectory simulation (the CPU proxy; real-model IoU
+# at equal wall is TPU measurement item 7).
+ASYNC_FEDERATION_SCHEMA: dict = {
+    "storm": dict,
+    "sync_equivalence": dict,
+    "recovery": dict,
+    "trajectory": dict,
+}
+# Per-arm keys of detail.async_federation.storm.{sync,buffered}.
+ASYNC_STORM_ARM_SCHEMA: dict = {
+    "wall_s": (int, float),
+    "accepted_updates": int,
+    "global_versions": int,
+    "updates_per_sec": (int, float),
+    "versions_per_min": (int, float),
 }
 # Typed keys of detail.cohort_scale (round 13): the time-multiplexed-cohort
 # + hierarchical-tree contract — the group-count sweep's wall scaling, the
@@ -271,6 +291,34 @@ def validate_detail(detail: dict) -> list:
                         f"update_compression.wire[{name!r}][{key!r}]: "
                         f"{type(point[key]).__name__}"
                     )
+    asyncf = detail.get("async_federation")
+    if isinstance(asyncf, dict) and "error" not in asyncf:
+        for key, typs in ASYNC_FEDERATION_SCHEMA.items():
+            if key not in asyncf:
+                bad.append(f"async_federation[{key!r}] missing")
+            elif not isinstance(asyncf[key], typs):
+                bad.append(
+                    f"async_federation[{key!r}]: {type(asyncf[key]).__name__}"
+                )
+        storm = asyncf.get("storm")
+        for arm in ("sync", "buffered"):
+            point = (storm if isinstance(storm, dict) else {}).get(arm)
+            if not isinstance(point, dict):
+                bad.append(
+                    f"async_federation.storm[{arm!r}]: "
+                    f"{type(point).__name__}"
+                )
+                continue
+            for key, typs in ASYNC_STORM_ARM_SCHEMA.items():
+                if key not in point:
+                    bad.append(
+                        f"async_federation.storm[{arm!r}][{key!r}] missing"
+                    )
+                elif not isinstance(point[key], typs):
+                    bad.append(
+                        f"async_federation.storm[{arm!r}][{key!r}]: "
+                        f"{type(point[key]).__name__}"
+                    )
     cohort = detail.get("cohort_scale")
     if isinstance(cohort, dict) and "error" not in cohort:
         for key, typs in COHORT_SCALE_SCHEMA.items():
@@ -333,6 +381,15 @@ COMPRESSION_ROUNDS = int(os.environ.get("FEDCRACK_BENCH_COMPRESSION_ROUNDS", "3"
 COHORT = os.environ.get("FEDCRACK_BENCH_COHORT", "1") == "1"
 COHORT_TREE_CLIENTS = int(os.environ.get("FEDCRACK_BENCH_COHORT_CLIENTS", "1024"))
 COHORT_TREE_FANOUT = int(os.environ.get("FEDCRACK_BENCH_COHORT_FANOUT", "32"))
+
+# Async-federation section (round 14, detail.async_federation): the chaos
+# straggler-storm sync-vs-buffered A/B (real gRPC, seeded heavy-tail
+# delays, equal wall), the bit-exact sync-degeneration pin (buffer_k=N,
+# alpha=0 == sync FedAvg, sha-compared), the buffered mid-buffer
+# kill→restart drill, and a deterministic equal-wall trajectory
+# simulation. "0" opts out.
+ASYNC = os.environ.get("FEDCRACK_BENCH_ASYNC", "1") == "1"
+ASYNC_SEED = int(os.environ.get("FEDCRACK_BENCH_ASYNC_SEED", "0"))
 
 # Serving-plane SLO section (round 10, detail.serving): boots the full
 # serve stack in-process (engine + micro-batcher + hot-swap manager + gRPC
@@ -2049,6 +2106,171 @@ def _bench_cohort_scale() -> dict:
     return out
 
 
+def _async_sync_equivalence() -> dict:
+    """The buffered mode's escape hatch, pinned in the artifact: with
+    ``buffer_k = cohort_size`` and ``staleness_alpha = 0`` the buffered
+    flush IS sync FedAvg — sha-identical global bytes over the same
+    updates — and a permuted arrival order flushes to the same bytes (the
+    sorted-fold discipline). Transition-driven, host-only, milliseconds."""
+    import hashlib
+
+    from fedcrack_tpu.configs import FedConfig
+    from fedcrack_tpu.fed import rounds as R
+    from fedcrack_tpu.fed.serialization import tree_to_bytes
+
+    def _vars(v):
+        return {"params": {"w": np.full((8, 8), v, np.float32)}}
+
+    values = {"a": 1.0, "b": 3.0, "c": 6.0}
+    samples = {"a": 10, "b": 30, "c": 20}
+
+    def drive(mode: str, order: tuple) -> tuple[str, int]:
+        kw = (
+            dict(mode="buffered", buffer_k=3, staleness_alpha=0.0, max_staleness=4)
+            if mode == "buffered"
+            else {}
+        )
+        cfg = FedConfig(
+            max_rounds=3, cohort_size=3, registration_window_s=3600.0, **kw
+        )
+        st = R.initial_state(cfg, _vars(0.0))
+        now = 0.0
+        for c in ("a", "b", "c"):
+            now += 1e-3
+            st, _ = R.transition(st, R.Ready(cname=c, now=now))
+        for rnd in range(1, 4):
+            for c in order:
+                now += 1e-3
+                st, _ = R.transition(st, R.PullWeights(cname=c, now=now))
+            for c in order:
+                now += 1e-3
+                st, _ = R.transition(
+                    st,
+                    R.TrainDone(
+                        cname=c,
+                        round=rnd,
+                        blob=tree_to_bytes(_vars(values[c] + rnd)),
+                        num_samples=samples[c],
+                        now=now,
+                    ),
+                )
+        return hashlib.sha256(st.global_blob).hexdigest(), int(st.model_version)
+
+    sync_sha, _ = drive("sync", ("a", "b", "c"))
+    buf_sha, buf_v = drive("buffered", ("a", "b", "c"))
+    perm_sha, _ = drive("buffered", ("c", "a", "b"))
+    return {
+        "sync_sha": sync_sha,
+        "buffered_sha": buf_sha,
+        "bit_identical": sync_sha == buf_sha,
+        "arrival_order_independent": buf_sha == perm_sha,
+        "global_versions": buf_v,
+    }
+
+
+def _async_trajectory_sim(
+    seed: int = ASYNC_SEED,
+    n_clients: int = 8,
+    buffer_k: int = 2,
+    alpha: float = 0.5,
+    rounds: int = 6,
+    lr: float = 0.1,
+) -> dict:
+    """Equal-wall trajectory quality, sync vs buffered, under the SAME
+    seeded storm schedule — a deterministic event-clock simulation (no
+    sleeps) of a toy quadratic (each client pulls the global toward its
+    own target; the optimum is the target mean). The sync arm runs
+    ``rounds`` barrier rounds (wall = sum of per-round max delays); the
+    buffered arm replays the same per-(client, iteration) delays up to
+    that wall. This is the CPU PROXY for 'trajectory quality at equal
+    wall' — the real-model crack-IoU point is TPU measurement item 7."""
+    import heapq
+    import random as _random
+
+    from fedcrack_tpu.chaos.plan import STRAGGLER_DELAY, FaultPlan
+    from fedcrack_tpu.fed.buffered import staleness_weight
+
+    names = [f"c{i}" for i in range(n_clients)]
+    n_iter = rounds * 8
+    plan = FaultPlan.storm(
+        seed,
+        clients=names,
+        n_iterations=n_iter,
+        tail_alpha=1.1,
+        scale_s=0.03,
+        cap_s=0.8,
+    )
+    delays = {
+        (f.client, f.round): f.delay_s
+        for f in plan.pending
+        if f.kind == STRAGGLER_DELAY
+    }
+    rng = _random.Random(seed)
+    targets = {n: rng.uniform(0.5, 1.5) for n in names}
+    opt = sum(targets[n] for n in names) / n_clients
+
+    def local(w: float, n: str) -> float:
+        return w + lr * (targets[n] - w)
+
+    # Sync arm: each round's wall is the cohort MAX delay.
+    w, t = 0.0, 0.0
+    for r in range(1, rounds + 1):
+        t += max(delays[(n, r)] for n in names)
+        w = sum(local(w, n) for n in names) / n_clients
+    sync_wall, sync_loss = t, (w - opt) ** 2
+
+    # Buffered arm to the same wall: clients loop, the server flushes the
+    # staleness-weighted buffer at K (the fed/buffered.py semantics, on
+    # the toy model).
+    w, version = 0.0, 0
+    buf: list = []
+    heap: list = []
+    for n in names:
+        heapq.heappush(heap, (delays[(n, 1)], n, 1, w, version))
+    while heap and heap[0][0] <= sync_wall:
+        t_fin, n, it, base_w, base_v = heapq.heappop(heap)
+        u = local(base_w, n)
+        wt = staleness_weight(version - base_v, alpha)
+        buf.append((u, wt))
+        if len(buf) >= buffer_k:
+            # The fed/buffered.py flush: weighted buffer mean, anchored on
+            # the current global by the mean staleness weight.
+            tot = sum(x for _, x in buf)
+            mean = sum(u * x for u, x in buf) / tot
+            mix = tot / len(buf)
+            w = (1.0 - mix) * w + mix * mean
+            version += 1
+            buf = []
+        nxt = it + 1
+        d = delays[(n, (nxt - 1) % n_iter + 1)]
+        heapq.heappush(heap, (t_fin + d, n, nxt, w, version))
+    buffered_loss = (w - opt) ** 2
+    return {
+        "equal_wall_s": round(sync_wall, 4),
+        "sync_final_loss": round(sync_loss, 8),
+        "buffered_final_loss": round(buffered_loss, 8),
+        "sync_versions": rounds,
+        "buffered_versions": int(version),
+        "buffered_at_least_as_close": buffered_loss <= sync_loss,
+    }
+
+
+def _bench_async_federation() -> dict:
+    """detail.async_federation (round 14): storm A/B + sync-degeneration
+    pin + mid-buffer recovery + equal-wall trajectory sim."""
+    from fedcrack_tpu.tools.chaos_drill import (
+        run_buffered_kill_drill,
+        run_straggler_storm_drill,
+    )
+
+    return {
+        "storm": run_straggler_storm_drill(seed=ASYNC_SEED),
+        "sync_equivalence": _async_sync_equivalence(),
+        "recovery": run_buffered_kill_drill(),
+        "trajectory": _async_trajectory_sim(),
+    }
+
+
 def main() -> None:
     # Smoke-test hook: this image pre-imports jax at interpreter startup with
     # the axon (real TPU tunnel) platform, so a JAX_PLATFORMS=cpu env override
@@ -2620,6 +2842,26 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
                 "cohort_scale",
                 cohort_est,
                 "estimate exceeds remaining budget",
+            )
+
+    # ---- async federation (round 14): the straggler-storm sync-vs-
+    # buffered A/B over a real gRPC control plane (seeded delays, equal
+    # wall — seconds of real sleeps), the bit-exact sync-degeneration pin,
+    # the mid-buffer kill→restart drill, and the equal-wall trajectory
+    # simulation (host-only, deterministic) ----
+    if ASYNC:
+        if _fits(20.0):
+            t0 = time.monotonic()
+            try:
+                detail["async_federation"] = _bench_async_federation()
+            except Exception as e:  # a host-only extra must never kill the artifact
+                detail["async_federation"] = {"error": repr(e)}
+            section_s["async_federation"] = time.monotonic() - t0
+            detail["budget"] = _budget_detail()
+            _set_payload(metric_headline, value, vs_baseline, detail)
+        else:
+            _skip(
+                skips, "async_federation", 20.0, "estimate exceeds remaining budget"
             )
 
     # ---- batch-scaling curve (bf16 flagship at batch 32/64; non-parity

@@ -12,6 +12,7 @@ from fedcrack_tpu.analysis.engine import Rule
 
 def all_rules() -> list[Rule]:
     from fedcrack_tpu.analysis.rules import (
+        async_plane,
         compress,
         deadcode,
         determinism,
@@ -22,7 +23,10 @@ def all_rules() -> list[Rule]:
     )
 
     out: list[Rule] = []
-    for pack in (determinism, durability, trace, transport, compress, locks, deadcode):
+    for pack in (
+        determinism, durability, trace, transport, compress, async_plane,
+        locks, deadcode,
+    ):
         out.extend(cls() for cls in pack.RULES)
     return out
 

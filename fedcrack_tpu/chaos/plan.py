@@ -54,6 +54,17 @@ SERVE_DEVICE_LOSS = "serve_device_loss"        # batch dispatch raises (device l
 # actually fired instead of silently matching nothing.
 EDGE_AGGREGATOR_CRASH = "edge_aggregator_crash"  # edge tier dies mid-round, restarts from statefile
 
+# Async-federation plane (round 14). A straggler STORM: every client in
+# the cohort draws per-iteration training delays from one seeded
+# heavy-tail (Pareto) distribution — the workload shape FedBuff exists
+# for, where a sync barrier's round wall is the per-round MAX delay while
+# buffered aggregation flushes on the K fastest. Scenario-harness kind
+# like the edge crash: `FaultPlan.storm` schedules the per-(client,
+# iteration) STRAGGLER_DELAY faults plus ONE storm marker the drill
+# consumes, so an artifact proves the storm actually ran (and both arms
+# of a sync-vs-buffered A/B replay the identical delay schedule).
+STRAGGLER_STORM = "straggler_storm"
+
 CLIENT_KINDS = frozenset(
     {
         CRASH_BEFORE_UPLOAD,
@@ -73,7 +84,8 @@ SERVE_KINDS = frozenset({SERVE_SWAP_MIDFLIGHT, SERVE_DEVICE_LOSS})
 # Scenario-harness kinds: consumed by scripted drills (a dead process runs
 # no hook); `client` carries the edge id.
 TREE_KINDS = frozenset({EDGE_AGGREGATOR_CRASH})
-ALL_KINDS = CLIENT_KINDS | MESH_KINDS | SERVE_KINDS | TREE_KINDS
+STORM_KINDS = frozenset({STRAGGLER_STORM})
+ALL_KINDS = CLIENT_KINDS | MESH_KINDS | SERVE_KINDS | TREE_KINDS | STORM_KINDS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -170,6 +182,71 @@ class FaultPlan:
                         client=rng.choice(names) if names else None,
                         delay_s=round(rng.uniform(0.05, max_delay_s), 3),
                         count=rng.randint(1, 2),
+                    )
+                )
+        return cls(faults)
+
+    @classmethod
+    def storm(
+        cls,
+        seed: int,
+        *,
+        clients: Iterable[str],
+        n_iterations: int,
+        tail_alpha: float = 1.2,
+        scale_s: float = 0.04,
+        cap_s: float = 0.6,
+        gust_p: float = 0.25,
+        gust_floor: float = 0.5,
+    ) -> "FaultPlan":
+        """A seeded straggler STORM (round 14): one heavy-tail
+        STRAGGLER_DELAY per (client, iteration), drawn from a MIXTURE —
+        with probability ``gust_p`` a "storm gust" uniform in
+        ``[gust_floor, 1] * cap_s`` (a client effectively down for the
+        round), otherwise the Pareto body ``min(cap_s, scale_s *
+        Pareto(tail_alpha))``. The gust component keeps the per-round MAX
+        over any real cohort near ``cap_s`` with high probability — the
+        wall a sync barrier serializes on — while the K fastest draws
+        (what a buffered flush waits for) stay near ``scale_s``; a pure
+        Pareto tail has the same expectations but seed-to-seed variance
+        that would make A/B artifacts flaky. Plus one STRAGGLER_STORM
+        marker fault (round 1) the drill consumes so the artifact proves
+        the storm fired. Same seed, same schedule — the sync and buffered
+        arms of an A/B replay identical delays: the sync arm reads
+        iteration r as its protocol round r, the buffered arm as the
+        client's r-th pull→train→push loop."""
+        if n_iterations < 1:
+            raise ValueError(f"n_iterations must be >= 1, got {n_iterations}")
+        if tail_alpha <= 0 or scale_s <= 0 or cap_s <= 0:
+            raise ValueError(
+                "tail_alpha, scale_s and cap_s must be positive, got "
+                f"{tail_alpha}/{scale_s}/{cap_s}"
+            )
+        if not 0.0 <= gust_p <= 1.0 or not 0.0 < gust_floor <= 1.0:
+            raise ValueError(
+                f"gust_p in [0, 1] and gust_floor in (0, 1] required, got "
+                f"{gust_p}/{gust_floor}"
+            )
+        names = sorted(clients)
+        if not names:
+            raise ValueError("storm needs at least one client")
+        faults = [Fault(kind=STRAGGLER_STORM, round=1)]
+        for name in names:
+            # Per-client stream seeded from (seed, name): a client's delay
+            # sequence is independent of cohort size or of the other
+            # clients' draw order.
+            rng = random.Random(f"{seed}/{name}")
+            for it in range(1, n_iterations + 1):
+                if rng.random() < gust_p:
+                    delay = cap_s * rng.uniform(gust_floor, 1.0)
+                else:
+                    delay = min(cap_s, scale_s * rng.paretovariate(tail_alpha))
+                faults.append(
+                    Fault(
+                        kind=STRAGGLER_DELAY,
+                        round=it,
+                        client=name,
+                        delay_s=round(delay, 4),
                     )
                 )
         return cls(faults)

@@ -272,11 +272,21 @@ def restore_server_state(
     # Route through initial_state so dtype-dependent derived fields (the
     # float32 decode template, the wire-dtype broadcast copy) are rebuilt
     # consistently with a fresh boot.
-    return R.initial_state(config, ckpt.variables)._replace(
+    fresh = R.initial_state(config, ckpt.variables)
+    return fresh._replace(
         phase=phase,
         current_round=ckpt.current_round,
         model_version=ckpt.model_version,
         history=ckpt.history,
         logs=ckpt.logs,
         server_opt_state=opt_state,
+        # Buffered mode (round 14): initial_state keys the retained-base
+        # window under version 0; the restored global IS the broadcast for
+        # the restored version — re-key it, or every post-restart upload
+        # would miss the base lookup and resync forever.
+        base_blobs=(
+            {int(ckpt.model_version): fresh.broadcast_blob}
+            if config.mode == "buffered"
+            else {}
+        ),
     )

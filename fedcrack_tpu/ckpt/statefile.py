@@ -39,6 +39,7 @@ def server_state_to_bytes(state: Any) -> bytes:
     pickle — same trust posture as the wire)."""
     from flax import serialization as flax_ser
 
+    from fedcrack_tpu.fed import buffered as _buffered
     from fedcrack_tpu.fed.serialization import tree_to_bytes
 
     opt_blob = None
@@ -73,6 +74,27 @@ def server_state_to_bytes(state: Any) -> bytes:
         },
         "codecs": {name: c for name, c in sorted(state.codecs.items())},
         "opt_state": opt_blob,
+        # Buffered-async mode (round 14, fed/buffered.py): the in-flight
+        # buffer, per-client pulled versions and retained base window — a
+        # mid-BUFFER kill resumes with the accepted updates intact and
+        # flushes to the bit-identical next global version. All three are
+        # canonically sorted (buffer by its own (cname, seq) flush key) so
+        # the snapshot bytes stay a pure function of state; the per-entry
+        # wire row is fed/buffered's ONE shared codec (the edge statefile
+        # uses the same pair, so the row can never drift positionally).
+        # Empty in sync mode; absent keys in pre-round-14 snapshots
+        # restore as empty.
+        "buffer": [
+            _buffered.buffer_entry_to_wire(e)
+            for e in sorted(
+                state.buffer, key=lambda e: (e["cname"], e["seq"])
+            )
+        ],
+        "pulled": {name: int(v) for name, v in sorted(state.pulled.items())},
+        # str keys: msgpack's strict_map_key refuses int map keys.
+        "base_blobs": {
+            str(int(v)): b for v, b in sorted(state.base_blobs.items())
+        },
     }
     return msgpack.packb(payload, use_bin_type=True)
 
@@ -82,6 +104,7 @@ def server_state_from_bytes(blob: bytes, config: Any) -> Any:
     (float32 decode template, wire-dtype broadcast blob) are reconstructed
     via ``initial_state`` so a wire-dtype change between runs cannot leave
     a stale broadcast copy."""
+    from fedcrack_tpu.fed import buffered as _buffered
     from fedcrack_tpu.fed import rounds as R
     from fedcrack_tpu.fed.serialization import tree_from_bytes
 
@@ -134,6 +157,22 @@ def server_state_from_bytes(blob: bytes, config: Any) -> Any:
             k: int(v) for k, v in payload.get("wire_bytes", {}).items()
         },
         codecs=dict(payload.get("codecs", {})),
+        buffer=tuple(
+            _buffered.buffer_entry_from_wire(e)
+            for e in payload.get("buffer", [])
+        ),
+        pulled={k: int(v) for k, v in payload.get("pulled", {}).items()},
+        base_blobs=(
+            {int(v): bytes(b) for v, b in payload.get("base_blobs", {}).items()}
+            # A pre-round-14 snapshot restored under a buffered config must
+            # still decode current-version deltas: seed the window with the
+            # restored global under its restored version number.
+            or (
+                {int(payload["model_version"]): state.broadcast_blob}
+                if config.mode == "buffered"
+                else {}
+            )
+        ),
         server_opt_state=opt_state,
         # Monotonic clocks do not survive a process: re-arm on first event
         # (rounds._advance_time stamps round_started_at when RUNNING).

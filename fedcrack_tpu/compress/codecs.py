@@ -330,7 +330,20 @@ class TopKDeltaCodec(Codec):
         *,
         round: int = 0,
         base_version: int = 0,
+        ef_decay: float = 1.0,
     ) -> bytes:
+        """``ef_decay`` (round 14, staleness-aware error feedback): the
+        committed residual is scaled by it — ``1.0`` (the default) is the
+        classic DGC accumulator, byte-identical to pre-round-14 encodes.
+        A buffered-async tier whose upload will be STALENESS-WEIGHTED by
+        ``w < 1`` passes ``ef_decay=w``: only ``w`` of the transmitted
+        delta reaches the global, so only ``w`` of the dropped remainder
+        is owed back — banking it undecayed would re-inject mass the
+        aggregator never discounted, and the accumulator would stop
+        draining under sustained staleness ('nothing lost, only delayed'
+        must converge; property-pinned in tests/test_buffered.py)."""
+        if not 0.0 <= ef_decay <= 1.0:
+            raise ValueError(f"ef_decay must be in [0, 1], got {ef_decay}")
         if base_blob is None:
             raise ValueError("topk_delta codec needs the round-base blob")
         deltas = _delta_leaves(blob, base_blob)
@@ -362,6 +375,8 @@ class TopKDeltaCodec(Codec):
             payload += idx.tobytes() + vals.tobytes()
             rem = eff.copy()
             rem[idx] = 0.0
+            if ef_decay != 1.0:
+                rem = rem * np.float32(ef_decay)
             new_residual.append(rem.reshape(d.shape))
         # Commit the drop, but keep the pre-drop state as the rollback
         # target: residual + kept == eff, so restoring eff un-loses the

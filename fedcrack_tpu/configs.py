@@ -192,6 +192,35 @@ class FedConfig:
 
     max_rounds: int = 5
     cohort_size: int = 2
+    # Async federation (round 14, fedcrack_tpu/fed/buffered.py): "sync" is
+    # the barrier round machine (reference semantics + all fixes); in
+    # "buffered" mode the server runs FedBuff-style buffered aggregation
+    # (Nguyen et al., 2022): updates are accepted AS THEY ARRIVE, each
+    # weighted by the polynomial staleness decay (1 + staleness)^-alpha
+    # (FedAsync, Xie et al., 2019), folded into a buffer of `buffer_k`
+    # updates, and flushed to a new global version at K — no round barrier,
+    # so one straggler never stalls the federation. Clients loop
+    # pull→train→push continuously. `buffer_k = cohort_size` with
+    # `staleness_alpha = 0` reproduces the sync FedAvg trajectory
+    # bit-exactly (test-pinned).
+    mode: str = "sync"
+    # Buffered mode: how many accepted updates trigger a flush (FedBuff's
+    # K). The round_deadline_s backstop flushes a non-empty partial buffer
+    # so a dwindling cohort cannot stall the version counter forever.
+    buffer_k: int = 2
+    # Polynomial staleness-decay exponent: an update trained on a base
+    # `s` versions behind the current global is weighted by
+    # (1 + s)^-alpha (on top of its sample count). 0 disables decay
+    # (every update weighs its plain sample count — the sync-degeneration
+    # escape hatch).
+    staleness_alpha: float = 0.5
+    # Updates staler than this many versions are REJECTED into the round
+    # history (like r8 sanitation rejects) and the sender is re-synced with
+    # the current global. Also bounds the window of past broadcast blobs
+    # the server retains for delta-frame decode (memory: max_staleness + 1
+    # broadcast-sized blobs). 0 = only updates against the current version
+    # are accepted.
+    max_staleness: int = 4
     # Seeded per-round cohort sampling (round 13): the seed behind
     # fed.algorithms.sample_cohort — harnesses that sample `cohort_size`
     # clients per round from a larger population (the time-multiplexed
@@ -376,6 +405,20 @@ class FedConfig:
             raise ValueError(
                 "data_placement must be 'streamed' or 'resident', got "
                 f"{self.data_placement!r}"
+            )
+        if self.mode not in ("sync", "buffered"):
+            raise ValueError(
+                f"mode must be 'sync' or 'buffered', got {self.mode!r}"
+            )
+        if self.buffer_k < 1:
+            raise ValueError(f"buffer_k must be >= 1, got {self.buffer_k}")
+        if self.staleness_alpha < 0.0:
+            raise ValueError(
+                f"staleness_alpha must be >= 0, got {self.staleness_alpha}"
+            )
+        if self.max_staleness < 0:
+            raise ValueError(
+                f"max_staleness must be >= 0, got {self.max_staleness}"
             )
         if self.cohort_seed < 0:
             # SeedSequence entropy must be non-negative; fail at config

@@ -58,6 +58,14 @@ Deliberate fixes over observed reference behavior (SURVEY.md §2.2):
     ``decoded_bytes_received``/``codecs`` alongside. Mixed cohorts (raw +
     framed) aggregate correctly because everything decodes to a full tree
     before FedAvg.
+12. Async federation (round 14, ``FedConfig.mode == "buffered"``,
+    :mod:`fedcrack_tpu.fed.buffered`): ``PullWeights`` and ``TrainDone``
+    dispatch to the FedBuff buffered aggregator instead of the round
+    barrier — updates fold into a K-sized staleness-weighted buffer as
+    they arrive and flush to a new global version at K. Enrollment, log
+    uploads, polls and the FIN protocol are shared verbatim; the deadline
+    becomes a partial-flush liveness backstop. ``mode == "sync"`` (the
+    default) is byte-for-byte the pre-round-14 machine.
 """
 
 from __future__ import annotations
@@ -216,6 +224,16 @@ class ServerState:
     # produced them. Folded into the history entry at aggregation.
     wire_bytes: Mapping[str, int] = dataclasses.field(default_factory=dict)
     codecs: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    # Buffered-async mode only (round 14, fed/buffered.py); empty in sync
+    # mode. `pulled` maps each client to the model_version it last pulled
+    # (the base its next upload decodes against); `buffer` holds the
+    # accepted-but-unflushed staleness-weighted updates; `base_blobs`
+    # retains the last max_staleness broadcast blobs so stale framed
+    # deltas can reconstruct. All three persist in the statefile so a
+    # mid-buffer kill resumes bit-exactly.
+    pulled: Mapping[str, int] = dataclasses.field(default_factory=dict)
+    buffer: tuple = ()
+    base_blobs: Mapping[int, bytes] = dataclasses.field(default_factory=dict)
 
     @property
     def broadcast_blob(self) -> bytes:
@@ -336,11 +354,15 @@ def initial_state(config: FedConfig, global_variables: Any) -> ServerState:
     model_evaluate module; SURVEY.md §2.5)."""
     cast = _wire_cast(config)
     blob = tree_to_bytes(global_variables)
+    wire_blob = tree_to_bytes(global_variables, cast_dtype=cast) if cast else b""
     return ServerState(
         config=config,
         global_blob=blob,
         template=jax.device_get(global_variables),
-        wire_blob=tree_to_bytes(global_variables, cast_dtype=cast) if cast else b"",
+        wire_blob=wire_blob,
+        # Buffered mode decodes stale deltas against retained past
+        # broadcasts; version 0's is the boot blob.
+        base_blobs={0: wire_blob or blob} if config.mode == "buffered" else {},
     )
 
 
@@ -367,6 +389,10 @@ def _ready_config(state: ServerState, status: str) -> dict[str, Any]:
         # that ignore the key keep sending raw blobs — always accepted.
         "update_codec": state.config.update_codec,
         "topk_fraction": state.config.topk_fraction,
+        # Async federation (round 14): "sync" clients block on the round
+        # close; "buffered" clients loop pull→train→push continuously
+        # (transport.client dispatches on this key).
+        "mode": state.config.mode,
     }
 
 
@@ -417,6 +443,13 @@ def _advance_time(state: ServerState, now: float) -> ServerState:
         # fast clients may have reported while enrollment was still open
         if _barrier_met(state):
             state = _aggregate(state, now)
+    if state.config.mode == "buffered":
+        # Buffered mode shares the enrollment machinery above; the round
+        # deadline below is replaced by the buffered flush/backstop (no
+        # cohort to shrink — the buffer is the quorum).
+        from fedcrack_tpu.fed.buffered import BufferedAggregator
+
+        return BufferedAggregator.advance_time(state, now)
     if (
         state.phase == PHASE_RUNNING
         and state.config.round_deadline_s > 0
@@ -457,19 +490,13 @@ def _advance_time(state: ServerState, now: float) -> ServerState:
     return state
 
 
-def _aggregate(state: ServerState, now: float) -> ServerState:
-    """FedAvg (optionally + FedOpt server step) over the round's received
-    updates; advance round/version."""
-    names = sorted(state.received.keys())
-    # Decode against the float32 template so server math keeps full
-    # precision even when the wire carries bfloat16 payloads.
-    trees = [
-        tree_from_bytes(state.received[n][0], template=state.template)
-        for n in names
-    ]
-    counts = [state.received[n][1] for n in names]
-    weights = counts if any(c > 0 for c in counts) else None
-    avg = fedavg(trees, weights)
+def apply_fedopt(state: ServerState, avg: Any) -> tuple[Any, Any]:
+    """The FedOpt server step on an aggregated tree: shared by the sync
+    barrier (:func:`_aggregate`) and the buffered flush
+    (:mod:`fedcrack_tpu.fed.buffered`) so both modes step the SAME
+    optimizer expression — a requirement of the buffered mode's bit-exact
+    sync degeneration. Returns ``(avg, opt_state)``; plain FedAvg passes
+    ``avg`` through untouched."""
     opt_state = state.server_opt_state
     tx = make_server_optimizer(
         state.config.server_optimizer,
@@ -485,6 +512,23 @@ def _aggregate(state: ServerState, now: float) -> ServerState:
         )
         avg = dict(avg)
         avg["params"] = new_params  # BN stats keep the plain average
+    return avg, opt_state
+
+
+def _aggregate(state: ServerState, now: float) -> ServerState:
+    """FedAvg (optionally + FedOpt server step) over the round's received
+    updates; advance round/version."""
+    names = sorted(state.received.keys())
+    # Decode against the float32 template so server math keeps full
+    # precision even when the wire carries bfloat16 payloads.
+    trees = [
+        tree_from_bytes(state.received[n][0], template=state.template)
+        for n in names
+    ]
+    counts = [state.received[n][1] for n in names]
+    weights = counts if any(c > 0 for c in counts) else None
+    avg = fedavg(trees, weights)
+    avg, opt_state = apply_fedopt(state, avg)
     new_blob = tree_to_bytes(avg)
     cast = _wire_cast(state.config)
     new_wire_blob = tree_to_bytes(avg, cast_dtype=cast) if cast else b""
@@ -593,10 +637,22 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
                 state = state._replace(phase=PHASE_RUNNING, round_started_at=now)
             return state, Reply(status=SW, config=_ready_config(state, SW))
 
-        case PullWeights():
+        case PullWeights(cname=cname):
             # Broadcasts the CURRENT global weights — after round R these are
             # the round-R average (fix #1; the reference resent init weights).
-            return state, Reply(status="OK", blob=state.broadcast_blob, title="parameters")
+            # The config map rides along so pollers learn the version/round
+            # the blob corresponds to (the buffered client loop pins its
+            # upload's base to it; sync clients ignore it).
+            if state.config.mode == "buffered":
+                from fedcrack_tpu.fed.buffered import BufferedAggregator
+
+                state = BufferedAggregator.record_pull(state, cname)
+            return state, Reply(
+                status="OK",
+                blob=state.broadcast_blob,
+                title="parameters",
+                config=_ready_config(state, "OK"),
+            )
 
         case TrainingNotice():
             return state, Reply(status="OK", title="T")
@@ -657,6 +713,14 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
                     blob=state.broadcast_blob,
                     config=_ready_config(state, FIN),
                 )
+            if state.config.mode == "buffered":
+                # FedBuff buffered aggregation (round 14): no round
+                # matching — the event's round tag is informational; the
+                # update's base VERSION (tracked at pull) is what gates
+                # and weights it.
+                from fedcrack_tpu.fed.buffered import BufferedAggregator
+
+                return BufferedAggregator.offer(state, event)
             if cname not in state.cohort:
                 return state, Reply(
                     status=REJECTED, config={"reason": "not in cohort"}

@@ -41,6 +41,7 @@ import msgpack
 import numpy as np
 
 from fedcrack_tpu.configs import FedConfig
+from fedcrack_tpu.fed import buffered as _buffered
 from fedcrack_tpu.fed import rounds as R
 from fedcrack_tpu.fed.algorithms import fedavg, sample_cohort
 from fedcrack_tpu.fed.rounds import decode_and_validate_update, quorum_target
@@ -96,6 +97,10 @@ class EdgeAggregator:
         state_path: str = "",
         update_codec: str = "null",
         topk_fraction: float = 0.01,
+        mode: str = "sync",
+        buffer_k: int = 2,
+        staleness_alpha: float = 0.5,
+        max_staleness: int = 4,
     ):
         if not 0.0 < quorum_fraction <= 1.0:
             raise ValueError(
@@ -103,6 +108,15 @@ class EdgeAggregator:
             )
         if update_codec not in ("null", "int8", "topk_delta"):
             raise ValueError(f"unknown update_codec {update_codec!r}")
+        if mode not in ("sync", "buffered"):
+            raise ValueError(f"mode must be 'sync' or 'buffered', got {mode!r}")
+        if buffer_k < 1:
+            raise ValueError(f"buffer_k must be >= 1, got {buffer_k}")
+        if staleness_alpha < 0.0 or max_staleness < 0:
+            raise ValueError(
+                "staleness_alpha and max_staleness must be >= 0, got "
+                f"{staleness_alpha}/{max_staleness}"
+            )
         self.edge_id = edge_id
         self.template = template
         self.quorum_fraction = quorum_fraction
@@ -110,6 +124,22 @@ class EdgeAggregator:
         self.state_path = state_path
         self.update_codec = update_codec
         self.topk_fraction = topk_fraction
+        # Buffered-async edge tier (round 14, the r13 follow-up): the same
+        # FedBuff discipline as the root server (fed/buffered.py), one tier
+        # down — leaf updates fold into a K-sized staleness-weighted buffer
+        # as they arrive and flush UPSTREAM as one weighted partial, so a
+        # straggling leaf never stalls its shard's hop up.
+        self.mode = mode
+        self.buffer_k = int(buffer_k)
+        self.staleness_alpha = float(staleness_alpha)
+        self.max_staleness = int(max_staleness)
+        self.buffer: list[dict] = []
+        # version -> root broadcast blob retained for stale-delta decode
+        # (pruned to the max_staleness window on every advance_base), and
+        # its decoded-tree cache (one decode per retained version, not per
+        # offer — the buffered accept path is the hot loop).
+        self.bases: dict[int, bytes] = {}
+        self._base_trees: dict[int, Any] = {}
         self.round = 0
         self.base_version = -1
         self.base_blob = b""
@@ -152,7 +182,46 @@ class EdgeAggregator:
         self.rejected = {}
         self.wire_bytes = {}
         self._base_tree = None
+        if self.mode == "buffered":
+            # Arm the retained-base window; the buffer deliberately
+            # survives (it is not per-round state — that is the point).
+            self._retain_base()
         self._persist()
+
+    def advance_base(self, round_idx: int, base_blob: bytes, base_version: int) -> None:
+        """Buffered mode: the root published a new global — make it the
+        current base (new leaf deltas pin to it) while RETAINING the old
+        one inside the ``max_staleness`` window, so in-flight leaf updates
+        trained on it still decode (staleness-weighted) instead of dying
+        on a base mismatch. The buffer carries across."""
+        if self.mode != "buffered":
+            raise RuntimeError("advance_base is a buffered-mode call")
+        self.round = int(round_idx)
+        self.base_blob = bytes(base_blob)
+        self.base_version = int(base_version)
+        self._base_tree = None
+        self._retain_base()
+        self._persist()
+
+    def _retain_base(self) -> None:
+        self.bases = {
+            v: b
+            for v, b in sorted(self.bases.items())
+            if self.base_version - v <= self.max_staleness
+        }
+        self.bases[self.base_version] = self.base_blob
+        self._base_trees = {
+            v: t
+            for v, t in sorted(self._base_trees.items())
+            if v in self.bases
+        }
+
+    def _decoded_retained_base(self, version: int):
+        tree = self._base_trees.get(version)
+        if tree is None:
+            tree = tree_from_bytes(self.bases[version], template=self.template)
+            self._base_trees[version] = tree
+        return tree
 
     def _decoded_base(self):
         if self._base_tree is None:
@@ -194,6 +263,131 @@ class EdgeAggregator:
         self.peak_resident_blobs = max(self.peak_resident_blobs, len(self.received))
         self._persist()
         return True, None
+
+    def offer_buffered(
+        self, cname: str, blob: bytes, num_samples: int, base_version: int
+    ) -> tuple[bool, str | None]:
+        """Buffered mode's leaf upload: gated by the SAME
+        ``decode_and_validate_update`` — against the base the leaf
+        actually trained on (``base_version``, retained in the window) —
+        staleness-weighted with the root server's closed form, and folded
+        into the buffer. Too-stale or unretained-base offers are recorded
+        and refused (the caller resyncs the leaf); sanitation failures are
+        refused loudly. Returns ``(accepted, rejection_reason)``."""
+        if self.mode != "buffered":
+            return False, "edge is not in buffered mode"
+        if cname not in self.leaves:
+            return False, f"{cname} not in this edge's shard"
+        staleness = self.base_version - int(base_version)
+        if staleness < 0:
+            return self._refuse(
+                cname, f"future base version {base_version} (edge at {self.base_version})"
+            )
+        if staleness > self.max_staleness:
+            return self._refuse(
+                cname,
+                f"too stale: base version {base_version} is {staleness} "
+                f"behind (max_staleness={self.max_staleness})",
+            )
+        if int(base_version) not in self.bases:
+            return self._refuse(
+                cname, f"base version {base_version} no longer retained"
+            )
+        decoded, wire_len, codec_name, problem = decode_and_validate_update(
+            blob,
+            num_samples,
+            template=self.template,
+            base_fn=lambda: self._decoded_retained_base(int(base_version)),
+            base_version=int(base_version),
+            sanitize=self.sanitize,
+        )
+        self.bytes_in += wire_len
+        if problem is not None:
+            return self._refuse(cname, problem)
+        self.buffer.append(
+            {
+                "cname": cname,
+                "seq": sum(1 for e in self.buffer if e["cname"] == cname),
+                "blob": decoded,
+                "ns": int(num_samples),
+                "staleness": int(staleness),
+                "weight": _buffered.staleness_weight(
+                    staleness, self.staleness_alpha
+                ),
+                "base_version": int(base_version),
+                "wire_len": int(wire_len),
+                "codec": codec_name,
+            }
+        )
+        self.peak_resident_blobs = max(self.peak_resident_blobs, len(self.buffer))
+        self._persist()
+        return True, None
+
+    def _refuse(self, cname: str, reason: str) -> tuple[bool, str]:
+        self.rejected[cname] = reason
+        self._persist()
+        return False, reason
+
+    def buffer_ready(self) -> bool:
+        return len(self.buffer) >= self.buffer_k
+
+    def flush_partial(self) -> tuple[bytes, int, dict]:
+        """Flush the buffer into ONE staleness-weighted partial for the hop
+        up: the same sorted ``(cname, seq)`` fold as the root's buffered
+        flush, weighted ``ns * (1 + staleness)^-alpha``. Returns
+        ``(blob_or_frame, total_samples, info)`` — ``total_samples`` is the
+        effective weight rounded to the wire's integer sample field
+        (floored at 1), and ``info`` carries the per-update staleness/
+        weight lists for observability. With a non-null ``update_codec``
+        the partial re-encodes as a delta against the CURRENT base, with
+        the top-k error-feedback residual decayed by the flush's mean
+        staleness weight (``ef_decay`` — only the discounted share of the
+        dropped mass is owed back; see TopKDeltaCodec). The edge does NOT
+        anchor its partial on the base the way the root flush mixes
+        against the current global — a partial is an INPUT to the parent
+        tier's weighted average, and its staleness discount is carried
+        there by the reduced effective sample count."""
+        if self.mode != "buffered":
+            raise RuntimeError("flush_partial is a buffered-mode call")
+        if not self.buffer:
+            raise RuntimeError(f"edge {self.edge_id}: flush of an empty buffer")
+        avg, entries, counts, eff = _buffered.fold_buffer(
+            self.buffer, self.template
+        )
+        total_eff = float(sum(eff))
+        total_ns = float(sum(counts))
+        blob = tree_to_bytes(avg)
+        if self.update_codec != "null":
+            if self._codec is None:
+                from fedcrack_tpu.compress import get_codec
+
+                self._codec = get_codec(
+                    self.update_codec,
+                    topk_fraction=self.topk_fraction,
+                    client_tag=self.edge_id,
+                )
+            decay = total_eff / total_ns if total_ns > 0 else 1.0
+            kwargs = (
+                {"ef_decay": decay} if self.update_codec == "topk_delta" else {}
+            )
+            blob = self._codec.encode_update(
+                blob,
+                self.base_blob,
+                round=self.round,
+                base_version=self.base_version,
+                **kwargs,
+            )
+        self.bytes_up += len(blob)
+        info = {
+            "clients": [e["cname"] for e in entries],
+            "staleness": [e["staleness"] for e in entries],
+            "weights": [e["weight"] for e in entries],
+            "buffer_fill": len(entries),
+            "effective_samples": total_eff,
+        }
+        self.buffer = []
+        self._persist()
+        return blob, max(1, int(round(total_eff))), info
 
     def partial(self) -> tuple[bytes, int]:
         """The shard's sample-weighted partial FedAvg as ONE upload for the
@@ -263,6 +457,25 @@ class EdgeAggregator:
             },
             "rejected": {k: v for k, v in sorted(self.rejected.items())},
             "wire_bytes": {k: int(v) for k, v in sorted(self.wire_bytes.items())},
+            # Buffered mode (round 14): in-flight buffer + retained bases
+            # + the knobs the buffer's SEMANTICS depend on (flush
+            # threshold, decay, staleness window) — a restore that fell
+            # back to ctor defaults would silently change when the
+            # resumed buffer flushes and how its entries weigh.
+            # Canonically sorted like everything above; the per-entry
+            # wire row is fed/buffered's shared codec. Empty/absent for
+            # sync edges and pre-round-14 snapshots.
+            "mode": self.mode,
+            "buffer_k": int(self.buffer_k),
+            "staleness_alpha": float(self.staleness_alpha),
+            "max_staleness": int(self.max_staleness),
+            "buffer": [
+                _buffered.buffer_entry_to_wire(e)
+                for e in sorted(
+                    self.buffer, key=lambda e: (e["cname"], e["seq"])
+                )
+            ],
+            "bases": {str(int(v)): b for v, b in sorted(self.bases.items())},
         }
         atomic_write_bytes(self.state_path, msgpack.packb(payload, use_bin_type=True))
 
@@ -276,6 +489,9 @@ class EdgeAggregator:
         sanitize: bool = True,
         update_codec: str = "null",
         topk_fraction: float = 0.01,
+        buffer_k: int = 2,
+        staleness_alpha: float = 0.5,
+        max_staleness: int = 4,
     ) -> "EdgeAggregator | None":
         """Resume a killed edge from its statefile: same round, same base,
         already-received updates intact. None when the file is missing or
@@ -301,6 +517,17 @@ class EdgeAggregator:
                 state_path=state_path,
                 update_codec=update_codec,
                 topk_fraction=topk_fraction,
+                mode=str(payload.get("mode", "sync")),
+                # The buffer's SEMANTICS (flush threshold, decay,
+                # staleness window) restore from the FILE: falling back
+                # to the caller's args would silently change when the
+                # resumed buffer flushes and how its entries weigh. The
+                # args are only the pre-round-14-snapshot default.
+                buffer_k=int(payload.get("buffer_k", buffer_k)),
+                staleness_alpha=float(
+                    payload.get("staleness_alpha", staleness_alpha)
+                ),
+                max_staleness=int(payload.get("max_staleness", max_staleness)),
             )
             edge.round = int(payload["round"])
             edge.base_version = int(payload["base_version"])
@@ -314,7 +541,15 @@ class EdgeAggregator:
             edge.wire_bytes = {
                 k: int(v) for k, v in payload.get("wire_bytes", {}).items()
             }
-            edge.peak_resident_blobs = len(edge.received)
+            edge.buffer = [
+                _buffered.buffer_entry_from_wire(e)
+                for e in payload.get("buffer", [])
+            ]
+            edge.bases = {
+                int(v): bytes(b)
+                for v, b in payload.get("bases", {}).items()
+            }
+            edge.peak_resident_blobs = max(len(edge.received), len(edge.buffer))
             return edge
         except Exception:
             log.exception("edge statefile %s corrupt; starting fresh", state_path)

@@ -355,6 +355,7 @@ def _run_segmented_round(
     mesh: Mesh,
     spec: P,
     acct: dict,
+    pipelined: dict | None = None,
 ):
     """One segmented round: K segment dispatches with the NEXT round's slab
     streaming chunk-by-chunk between them, then the finalize program.
@@ -365,6 +366,12 @@ def _run_segmented_round(
     ``{"live": bytes, "round_max": bytes}``). Returns ``(variables,
     metrics, out)`` where ``out`` carries the timeline, the (possibly
     host-viewed) cohort arrays, and the staged next-round state.
+
+    ``pipelined`` (round 14, ``round_overlap``): segment 0 was already
+    dispatched by the PREVIOUS round's tail (its carry/raw and the
+    validated cohort arrive here); the loop resumes at segment 1 and the
+    next-round data trigger fires on the first EXECUTED segment instead of
+    literal ``k == 0`` (with ``n_segments == 1`` it fires after the loop).
     """
     out: dict = {
         "next_buffers": None,
@@ -373,12 +380,35 @@ def _run_segmented_round(
         "next_data_s": 0.0,
     }
     timeline: list[dict] = []
-    active, n_samples = seg.check_inputs(si, active, n_samples)
-    carry = seg.init(variables)
-    raw_last = None
+    if pipelined is None:
+        active, n_samples = seg.check_inputs(si, active, n_samples)
+        carry = seg.init(variables)
+        raw_last = None
+        start_k = 0
+    else:
+        active, n_samples = pipelined["active"], pipelined["n_samples"]
+        carry, raw_last = pipelined["carry"], pipelined["raw"]
+        timeline.append(pipelined["entry"])
+        start_k = 1
     pending: list = []
     nxt = None
-    for k in range(seg.n_segments):
+    did_data = False
+
+    def _pull_next_data():
+        nonlocal nxt, pending, did_data
+        did_data = True
+        tdd = time.perf_counter()
+        nxt = data_fn(round_idx + 1)
+        out["next_data_s"] = time.perf_counter() - tdd
+        if nxt is not None:
+            ni, nm, na, nn = nxt
+            out["next_cohort"] = (na, nn)
+            out["next_bytes"] = int(ni.nbytes + nm.nbytes)
+            nic, nmc = split_epoch_slab(ni, nm, n_chunks)
+            pending = list(zip(nic, nmc))
+            out["next_buffers"] = ([], [])
+
+    for k in range(start_k, seg.n_segments):
         td = time.perf_counter()
         carry, raw_last = seg.segment(carry, variables, si, sm)
         entry = {
@@ -386,17 +416,8 @@ def _run_segmented_round(
             "dispatch_s": round(time.perf_counter() - td, 4),
         }
         if overlap_staging and round_idx + 1 < n_rounds:
-            if k == 0:
-                tdd = time.perf_counter()
-                nxt = data_fn(round_idx + 1)
-                out["next_data_s"] = time.perf_counter() - tdd
-                if nxt is not None:
-                    ni, nm, na, nn = nxt
-                    out["next_cohort"] = (na, nn)
-                    out["next_bytes"] = int(ni.nbytes + nm.nbytes)
-                    nic, nmc = split_epoch_slab(ni, nm, n_chunks)
-                    pending = list(zip(nic, nmc))
-                    out["next_buffers"] = ([], [])
+            if not did_data:
+                _pull_next_data()
             if pending:
                 # One chunk transfer rides under each in-flight segment
                 # (all of them at k=0 in round-grain mode).
@@ -414,6 +435,11 @@ def _run_segmented_round(
                 entry["staging_s"] = round(time.perf_counter() - tss, 4)
                 entry["staged_bytes"] = nb
         timeline.append(entry)
+    # A fully pipelined single-segment round never entered the loop: the
+    # next round's data still has to be produced + staged (under the
+    # in-flight segment 0 + finalize).
+    if overlap_staging and round_idx + 1 < n_rounds and not did_data:
+        _pull_next_data()
     # Chunks the segment loop didn't reach (n_chunks was clamped below
     # n_segments, or data_fn ran long): still overlapped with the in-flight
     # tail segments + finalize.
@@ -453,11 +479,14 @@ def _run_segmented_round_resident(
     overlap_staging: bool,
     mesh: Mesh,
     acct: dict,
+    pipelined: dict | None = None,
 ):
     """One segmented round on the resident plane: K segment dispatches over
     the shared device pool, each gathering by its own plan slice. The next
     round's plan (kilobytes) stages after the first dispatch — there is no
-    slab to stream chunk-by-chunk, which is the point."""
+    slab to stream chunk-by-chunk, which is the point. ``pipelined`` as in
+    :func:`_run_segmented_round` (segment 0 pre-dispatched by the previous
+    round's tail under ``round_overlap``)."""
     out: dict = {
         "next_buffers": None,
         "next_cohort": None,
@@ -466,37 +495,113 @@ def _run_segmented_round_resident(
         "next_host_idx": None,
     }
     timeline: list[dict] = []
-    active, n_samples = seg.check_inputs(pool_dev, active, n_samples, idx=host_idx)
-    carry = seg.init(variables)
-    raw_last = None
-    for k in range(seg.n_segments):
+    if pipelined is None:
+        active, n_samples = seg.check_inputs(
+            pool_dev, active, n_samples, idx=host_idx
+        )
+        carry = seg.init(variables)
+        raw_last = None
+        start_k = 0
+    else:
+        active, n_samples = pipelined["active"], pipelined["n_samples"]
+        carry, raw_last = pipelined["carry"], pipelined["raw"]
+        timeline.append(pipelined["entry"])
+        start_k = 1
+    did_data = False
+
+    def _pull_next_plan(entry=None):
+        nonlocal did_data
+        did_data = True
+        tdd = time.perf_counter()
+        nxt = data_fn(round_idx + 1)
+        out["next_data_s"] = time.perf_counter() - tdd
+        if nxt is not None:
+            nidx, na, nn = nxt
+            nidx = np.ascontiguousarray(np.asarray(nidx, np.int32))
+            out["next_cohort"] = (na, nn)
+            out["next_host_idx"] = nidx
+            out["next_bytes"] = int(nidx.nbytes)
+            tss = time.perf_counter()
+            out["next_buffers"] = stage_round_indices(nidx, mesh, seg)
+            acct["live"] += out["next_bytes"]
+            acct["round_max"] = max(acct["round_max"], acct["live"])
+            if entry is not None:
+                entry["staging_s"] = round(time.perf_counter() - tss, 4)
+                entry["staged_bytes"] = out["next_bytes"]
+
+    for k in range(start_k, seg.n_segments):
         td = time.perf_counter()
         carry, raw_last = seg.segment(carry, variables, pool_dev, idx_parts[k])
         entry = {
             "segment": k,
             "dispatch_s": round(time.perf_counter() - td, 4),
         }
-        if overlap_staging and round_idx + 1 < n_rounds and k == 0:
-            tdd = time.perf_counter()
-            nxt = data_fn(round_idx + 1)
-            out["next_data_s"] = time.perf_counter() - tdd
-            if nxt is not None:
-                nidx, na, nn = nxt
-                nidx = np.ascontiguousarray(np.asarray(nidx, np.int32))
-                out["next_cohort"] = (na, nn)
-                out["next_host_idx"] = nidx
-                out["next_bytes"] = int(nidx.nbytes)
-                tss = time.perf_counter()
-                out["next_buffers"] = stage_round_indices(nidx, mesh, seg)
-                acct["live"] += out["next_bytes"]
-                acct["round_max"] = max(acct["round_max"], acct["live"])
-                entry["staging_s"] = round(time.perf_counter() - tss, 4)
-                entry["staged_bytes"] = out["next_bytes"]
+        if overlap_staging and round_idx + 1 < n_rounds and not did_data:
+            _pull_next_plan(entry)
         timeline.append(entry)
+    if overlap_staging and round_idx + 1 < n_rounds and not did_data:
+        _pull_next_plan()
     variables, metrics = seg.finalize(carry, variables, active, n_samples, raw_last)
     out["timeline"] = timeline
     out["active"], out["n_samples"] = active, n_samples
     return variables, metrics, out
+
+
+def _dispatch_pipelined_segment(
+    seg: SegmentedRound,
+    out_vars: Any,
+    resident: bool,
+    *,
+    si,
+    sm,
+    active,
+    n_samples,
+    host_idx_cur,
+    segout,
+    next_buffers,
+    next_cohort,
+):
+    """Round-overlap (round 14): dispatch the NEXT round's init + segment-0
+    programs against the in-flight current round's output, before the host
+    blocks on the current round's metrics. Data dependencies (the new
+    variables) order the device; the host merely enqueues earlier — same
+    expression tree, bit-identical trajectory. When the next round reuses
+    this round's buffers (``data_fn`` returned None) the dispatch runs over
+    the current staged data and cohort."""
+    td = time.perf_counter()
+    if resident:
+        if next_buffers is not None:
+            idx_parts = next_buffers
+            na, nn = next_cohort
+            host_idx = segout["next_host_idx"]
+        else:
+            idx_parts = sm
+            na, nn = active, n_samples
+            host_idx = host_idx_cur
+        pa, pn = seg.check_inputs(si, na, nn, idx=host_idx)
+        carry = seg.init(out_vars)
+        carry, raw = seg.segment(carry, out_vars, si, idx_parts[0])
+    else:
+        if next_buffers is not None:
+            nsi, nsm = tuple(next_buffers[0]), tuple(next_buffers[1])
+            na, nn = next_cohort
+        else:
+            nsi, nsm = si, sm
+            na, nn = active, n_samples
+        pa, pn = seg.check_inputs(nsi, na, nn)
+        carry = seg.init(out_vars)
+        carry, raw = seg.segment(carry, out_vars, nsi, nsm)
+    return {
+        "carry": carry,
+        "raw": raw,
+        "active": pa,
+        "n_samples": pn,
+        "entry": {
+            "segment": 0,
+            "dispatch_s": round(time.perf_counter() - td, 4),
+            "pipelined": True,
+        },
+    }
 
 
 def run_mesh_federation(
@@ -509,6 +614,7 @@ def run_mesh_federation(
     image_spec: P | None = None,
     overlap_staging: bool = True,
     segment_overlap: bool = True,
+    round_overlap: bool = False,
     data_placement: str = "streamed",
     sample_pool: SamplePool | None = None,
     streamed_round_fn: Callable | None = None,
@@ -549,6 +655,23 @@ def run_mesh_federation(
       the bus); ``False`` keeps round-grain staging (the full next slab
       transfers after the first segment dispatch). Ignored for monolithic
       ``round_fn``s.
+    - ``round_overlap`` (round 14, segmented rounds only): overlap round
+      N+1's FIRST SEGMENT dispatch with round N's aggregation tail — after
+      round N's finalize program is dispatched (asynchronously), round
+      N+1's init + segment-0 programs are dispatched against its output
+      BEFORE the host blocks on round N's metrics readback, so the
+      readback + record bookkeeping + ``on_round`` host work hide under
+      device compute instead of serializing the rounds at the host. Pure
+      host scheduling: the device-side expression tree is unchanged, so
+      the trajectory is BIT-identical to ``round_overlap=False``
+      (test-pinned). Requires a ``SegmentedRound`` (the r7 segment
+      boundaries are the interleave points), ``overlap_staging=True`` (the
+      next round's data must be staged before its segment can dispatch),
+      and ``max_round_retries == 0`` (a pipelined segment dispatched
+      against a round that later fails its finiteness check would need
+      unwinding). The pipelined segment's dispatch time is recorded in the
+      CONSUMING round's timeline (``"pipelined": True``) but rode under
+      the previous round's wall.
     - ``data_placement``: ``"streamed"`` (default — the contracts above) or
       ``"resident"``: ``round_fn`` must be built with
       ``data_placement="resident"``, ``sample_pool`` must be the
@@ -666,6 +789,26 @@ def run_mesh_federation(
         )
     spec = image_spec if image_spec is not None else P(CLIENTS, None, BATCH)
     seg = round_fn if isinstance(round_fn, SegmentedRound) else None
+    if round_overlap:
+        if seg is None:
+            raise ValueError(
+                "round_overlap=True requires a SegmentedRound — the r7 "
+                "segment boundaries are the interleave points (an HBM-guard "
+                "fallback to a monolithic streamed_round_fn cannot pipeline)"
+            )
+        if not overlap_staging:
+            raise ValueError(
+                "round_overlap=True requires overlap_staging=True: the next "
+                "round's data must be staged before its first segment can "
+                "dispatch early"
+            )
+        if max_round_retries > 0:
+            raise ValueError(
+                "round_overlap does not compose with max_round_retries: a "
+                "pipelined segment dispatched against a round that later "
+                "fails its finiteness check would need unwinding — run "
+                "preemption tolerance without round-overlap"
+            )
     hist = list(history)
 
     t0 = time.perf_counter()
@@ -706,6 +849,10 @@ def run_mesh_federation(
     acct = {"live": base_bytes + cur_bytes, "round_max": base_bytes + cur_bytes}
 
     records: list[RoundRecord] = []
+    # round_overlap: the NEXT round's pre-dispatched segment-0 state
+    # (carry/raw/validated cohort + its timeline entry), produced at the
+    # previous round's tail and consumed by the next runner call.
+    pipelined_state: dict | None = None
     for r in range(start_round, n_rounds):
         # Preemption tolerance: snapshot the round's input weights so a
         # failed attempt (device loss, non-finite output) can replay THIS
@@ -794,6 +941,7 @@ def run_mesh_federation(
                         overlap_staging=overlap_staging,
                         mesh=mesh,
                         acct=acct,
+                        pipelined=pipelined_state,
                     )
                     if post is not None:
                         out_vars, metrics = post(out_vars, metrics)
@@ -820,6 +968,7 @@ def run_mesh_federation(
                         mesh=mesh,
                         spec=spec,
                         acct=acct,
+                        pipelined=pipelined_state,
                     )
                     if post is not None:
                         out_vars, metrics = post(out_vars, metrics)
@@ -835,6 +984,27 @@ def run_mesh_federation(
                 ):
                     raise NonFiniteRound(
                         f"round {r} produced non-finite weights/metrics"
+                    )
+                pipelined_state = None
+                if round_overlap and r + 1 < n_rounds:
+                    # Dispatch round r+1's init + segment 0 against this
+                    # round's (still in-flight) output BEFORE blocking on
+                    # its metrics — round N's aggregation-tail readback now
+                    # rides under round N+1's first segment. Device
+                    # ordering is by data dependency, so the math is
+                    # bit-identical to the unpipelined schedule.
+                    pipelined_state = _dispatch_pipelined_segment(
+                        seg,
+                        out_vars,
+                        resident,
+                        si=si,
+                        sm=sm,
+                        active=active,
+                        n_samples=n_samples,
+                        host_idx_cur=host_idx_cur,
+                        segout=segout if seg is not None else None,
+                        next_buffers=next_buffers,
+                        next_cohort=next_cohort,
                     )
                 # Round barrier: metrics depend on every step of every client.
                 metrics_host = jax.tree_util.tree_map(np.asarray, metrics)
@@ -1013,6 +1183,91 @@ def _stage_group_resident(pool_i, pool_m, idx, mesh):
     return (si, sm), sx
 
 
+def _prep_cohort_round(
+    cohort_round: CohortRound,
+    r: int,
+    data,
+    sample_pool: SamplePool | None,
+    resident: bool,
+) -> dict:
+    """Validate + pad one round's cohort data into the staging-ready form
+    (shared by the inline and the round-overlap pipelined paths)."""
+    if data is None:
+        raise ValueError(f"data_fn({r}) returned None: a cohort round never reuses")
+    g = cohort_round.group_size
+    prep: dict = {}
+    if resident:
+        idx, active, n_samples = data
+        idx = np.ascontiguousarray(np.asarray(idx, np.int32))
+        c = idx.shape[0]
+        if sample_pool.n_clients != c:
+            raise ValueError(
+                f"sample_pool carries {sample_pool.n_clients} clients, "
+                f"round {r}'s plan {c} — the pool's client axis must "
+                "align with the cohort"
+            )
+        prep["idx"] = idx
+    else:
+        images, masks, active, n_samples = data
+        images = np.asarray(images)
+        masks = np.asarray(masks)
+        c = images.shape[0]
+        cohort_round.seg.validate_data(images)
+        prep["images"], prep["masks"] = images, masks
+    active = np.asarray(active, np.float32)
+    n_samples = np.asarray(n_samples, np.float32)
+    if active.shape[0] != c:
+        raise ValueError(
+            f"cohort data carries {c} clients, mask {active.shape[0]}"
+        )
+    if float(np.sum(active * n_samples)) <= 0.0:
+        raise ValueError(
+            "non-positive total FedAvg weight: every cohort client dropped"
+        )
+    n_groups = cohort_round.n_groups(c)
+    c_pad = n_groups * g
+    prep["active"] = pad_cohort_axis(active, c_pad)
+    prep["n_samples"] = pad_cohort_axis(n_samples, c_pad)
+    prep["c"], prep["n_groups"] = c, n_groups
+    return prep
+
+
+def _stage_cohort_group(
+    prep: dict,
+    gi: int,
+    g: int,
+    mesh: Mesh,
+    spec: P,
+    sample_pool: SamplePool | None,
+    resident: bool,
+):
+    """Stage ONE group's slab (or resident pool slice + plan), padding only
+    the last group's slice for ragged cohorts."""
+    c = prep["c"]
+    lo, hi = gi * g, (gi + 1) * g
+
+    def slice_pad(arr):
+        # Pad ONLY the last group's slice (ragged cohorts): padding the
+        # whole cohort array up front would copy the entire pool/slab
+        # host-side every round — GBs of memcpy for one short group.
+        part = arr[lo:min(hi, c)]
+        return part if part.shape[0] == hi - lo else pad_cohort_axis(part, hi - lo)
+
+    ts = time.perf_counter()
+    if resident:
+        pi = slice_pad(sample_pool.images)
+        pm = slice_pad(sample_pool.masks)
+        ix = slice_pad(prep["idx"])
+        bufs = _stage_group_resident(pi, pm, ix, mesh)
+        nbytes = int(pi.nbytes + pm.nbytes + ix.nbytes)
+    else:
+        gi_imgs = slice_pad(prep["images"])
+        gi_msks = slice_pad(prep["masks"])
+        bufs = _stage_group_slab(gi_imgs, gi_msks, mesh, spec)
+        nbytes = int(gi_imgs.nbytes + gi_msks.nbytes)
+    return bufs, nbytes, time.perf_counter() - ts
+
+
 def run_cohort_federation(
     cohort_round: CohortRound,
     variables: Any,
@@ -1022,6 +1277,7 @@ def run_cohort_federation(
     *,
     sample_pool: SamplePool | None = None,
     image_spec: P | None = None,
+    round_overlap: bool = False,
     on_round: Callable[[RoundRecord, Any], None] | None = None,
 ) -> tuple[Any, list[RoundRecord]]:
     """Drive a time-multiplexed cohort federation (round 13): each round's
@@ -1047,6 +1303,17 @@ def run_cohort_federation(
     - ``on_round(record, variables)``: per-round hook, as in
       :func:`run_mesh_federation`.
 
+    ``round_overlap`` (round 14): overlap round N+1's cohort production,
+    first-group staging AND first-group dispatch with round N's
+    aggregation tail — after round N's ``finish`` program is dispatched
+    (asynchronously), round N+1's data_fn/staging/group-0 programs run
+    against its output BEFORE the host blocks on round N's metrics
+    readback. Pure host scheduling over the same data-dependency graph, so
+    the trajectory is BIT-identical to the unoverlapped schedule
+    (test-pinned). The pipelined group's dispatch/staging host time is
+    recorded in the CONSUMING round's timeline (``"pipelined": True``) but
+    rode under the previous round's wall.
+
     Returns the final global ``variables`` and one :class:`RoundRecord`
     per round; ``record.segments`` carries the per-GROUP host timeline
     (``{"group", "dispatch_s", "staging_s", "staged_bytes"}``) — round
@@ -1068,100 +1335,67 @@ def run_cohort_federation(
     spec = image_spec if image_spec is not None else P(CLIENTS, None, BATCH)
     g = cohort_round.group_size
     records: list[RoundRecord] = []
+    # round_overlap: round r+1's prepped data + staged group 0 + its
+    # dispatched (sums, raw) carry, produced at round r's tail.
+    pipeline: dict | None = None
 
     for r in range(n_rounds):
-        td = time.perf_counter()
-        data = data_fn(r)
-        data_s = time.perf_counter() - td
-        if data is None:
-            raise ValueError(f"data_fn({r}) returned None: a cohort round never reuses")
-        t0 = time.perf_counter()
-        if resident:
-            idx, active, n_samples = data
-            idx = np.ascontiguousarray(np.asarray(idx, np.int32))
-            c = idx.shape[0]
-            if sample_pool.n_clients != c:
-                raise ValueError(
-                    f"sample_pool carries {sample_pool.n_clients} clients, "
-                    f"round {r}'s plan {c} — the pool's client axis must "
-                    "align with the cohort"
-                )
+        if pipeline is None:
+            td = time.perf_counter()
+            data = data_fn(r)
+            data_s = time.perf_counter() - td
+            prep = _prep_cohort_round(cohort_round, r, data, sample_pool, resident)
+            t0 = time.perf_counter()
+            cur, cur_bytes, stage_s = _stage_cohort_group(
+                prep, 0, g, mesh, spec, sample_pool, resident
+            )
+            sums = cohort_round.zeros(variables)
+            pre_raw = None
+            pre_entry = None
         else:
-            images, masks, active, n_samples = data
-            images = np.asarray(images)
-            masks = np.asarray(masks)
-            c = images.shape[0]
-            cohort_round.seg.validate_data(images)
-        active = np.asarray(active, np.float32)
-        n_samples = np.asarray(n_samples, np.float32)
-        if active.shape[0] != c:
-            raise ValueError(
-                f"cohort data carries {c} clients, mask {active.shape[0]}"
-            )
-        if float(np.sum(active * n_samples)) <= 0.0:
-            raise ValueError(
-                "non-positive total FedAvg weight: every cohort client dropped"
-            )
-        n_groups = cohort_round.n_groups(c)
-        c_pad = n_groups * g
-        active = pad_cohort_axis(active, c_pad)
-        n_samples = pad_cohort_axis(n_samples, c_pad)
-
-        def slice_pad(arr, lo, hi):
-            # Pad ONLY the last group's slice (ragged cohorts): padding the
-            # whole cohort array up front would copy the entire pool/slab
-            # host-side every round — GBs of memcpy for one short group.
-            part = arr[lo:min(hi, c)]
-            return part if part.shape[0] == hi - lo else pad_cohort_axis(part, hi - lo)
-
-        def stage_group(gi):
-            lo, hi = gi * g, (gi + 1) * g
-            ts = time.perf_counter()
-            if resident:
-                pi = slice_pad(sample_pool.images, lo, hi)
-                pm = slice_pad(sample_pool.masks, lo, hi)
-                ix = slice_pad(idx, lo, hi)
-                bufs = _stage_group_resident(pi, pm, ix, mesh)
-                nbytes = int(pi.nbytes + pm.nbytes + ix.nbytes)
-            else:
-                gi_imgs = slice_pad(images, lo, hi)
-                gi_msks = slice_pad(masks, lo, hi)
-                bufs = _stage_group_slab(gi_imgs, gi_msks, mesh, spec)
-                nbytes = int(gi_imgs.nbytes + gi_msks.nbytes)
-            return bufs, nbytes, time.perf_counter() - ts
-
-        sums = cohort_round.zeros(variables)
+            prep = pipeline["prep"]
+            data_s = pipeline["data_s"]
+            t0 = pipeline["t0"]
+            cur, cur_bytes, stage_s = pipeline["staged"]
+            sums = pipeline["sums"]
+            pre_raw = pipeline["raw"]
+            pre_entry = pipeline["entry"]
+            pipeline = None
+        active, n_samples = prep["active"], prep["n_samples"]
+        n_groups = prep["n_groups"]
         raw_lasts = []
         timeline: list[dict] = []
         staged_total = 0
         staging_total = 0.0
-        live = 0
-        round_max = 0
-        cur, cur_bytes, stage_s = stage_group(0)
         live = cur_bytes
-        round_max = max(round_max, live)
+        round_max = live
         for gi in range(n_groups):
             lo = gi * g
-            tdp = time.perf_counter()
-            if resident:
-                (pool_dev, idx_dev) = cur
-                sums, raw = cohort_round.run_group(
-                    sums, variables, pool_dev, idx_dev,
-                    active[lo : lo + g], n_samples[lo : lo + g],
-                )
+            if gi == 0 and pre_raw is not None:
+                # Group 0 was dispatched by the previous round's tail
+                # (round_overlap): its fold already sits in `sums`.
+                raw = pre_raw
+                entry = pre_entry
             else:
-                si, sm = cur
-                sums, raw = cohort_round.run_group(
-                    sums, variables, si, sm,
-                    active[lo : lo + g], n_samples[lo : lo + g],
-                )
-            dispatch_s = time.perf_counter() - tdp
-            entry = {
-                "group": gi,
-                "dispatch_s": round(dispatch_s, 4),
-                "staging_s": round(stage_s, 4),
-                "staged_bytes": cur_bytes,
-            }
+                tdp = time.perf_counter()
+                if resident:
+                    (pool_dev, idx_dev) = cur
+                    sums, raw = cohort_round.run_group(
+                        sums, variables, pool_dev, idx_dev,
+                        active[lo : lo + g], n_samples[lo : lo + g],
+                    )
+                else:
+                    si, sm = cur
+                    sums, raw = cohort_round.run_group(
+                        sums, variables, si, sm,
+                        active[lo : lo + g], n_samples[lo : lo + g],
+                    )
+                entry = {
+                    "group": gi,
+                    "dispatch_s": round(time.perf_counter() - tdp, 4),
+                    "staging_s": round(stage_s, 4),
+                    "staged_bytes": cur_bytes,
+                }
             staged_total += cur_bytes
             staging_total += stage_s
             nxt = None
@@ -1169,7 +1403,9 @@ def run_cohort_federation(
                 # Next group's transfer rides under this group's compute
                 # (the dispatches above are async; only the staging
                 # barrier blocks the host).
-                nxt, nxt_bytes, stage_s = stage_group(gi + 1)
+                nxt, nxt_bytes, stage_s = _stage_cohort_group(
+                    prep, gi + 1, g, mesh, spec, sample_pool, resident
+                )
                 live += nxt_bytes
                 round_max = max(round_max, live)
             # Group barrier: raw_last depends on every step of every
@@ -1186,8 +1422,56 @@ def run_cohort_federation(
             if nxt is not None:
                 cur, cur_bytes = nxt, nxt_bytes
         out_vars, metrics = cohort_round.finish(
-            sums, variables, raw_lasts, active, c
+            sums, variables, raw_lasts, active, prep["c"]
         )
+        if round_overlap and r + 1 < n_rounds:
+            # Round r's finish is dispatched but not yet read back: produce
+            # round r+1's cohort, stage its first group and dispatch its
+            # first group program NOW, so all that host work (and the
+            # metrics readback below) hides under device compute. Data
+            # dependencies (out_vars) keep the device order — and thus the
+            # trajectory — bit-identical.
+            td = time.perf_counter()
+            data2 = data_fn(r + 1)
+            data2_s = time.perf_counter() - td
+            prep2 = _prep_cohort_round(
+                cohort_round, r + 1, data2, sample_pool, resident
+            )
+            t0n = time.perf_counter()
+            cur2, cur2_bytes, stage2_s = _stage_cohort_group(
+                prep2, 0, g, mesh, spec, sample_pool, resident
+            )
+            sums2 = cohort_round.zeros(out_vars)
+            tdp = time.perf_counter()
+            if resident:
+                (pool2, idx2) = cur2
+                sums2, raw2 = cohort_round.run_group(
+                    sums2, out_vars, pool2, idx2,
+                    prep2["active"][:g], prep2["n_samples"][:g],
+                )
+            else:
+                si2, sm2 = cur2
+                sums2, raw2 = cohort_round.run_group(
+                    sums2, out_vars, si2, sm2,
+                    prep2["active"][:g], prep2["n_samples"][:g],
+                )
+            pipeline = {
+                "prep": prep2,
+                "data_s": data2_s,
+                "t0": t0n,
+                "staged": (cur2, cur2_bytes, stage2_s),
+                "sums": sums2,
+                "raw": raw2,
+                "entry": {
+                    "group": 0,
+                    "dispatch_s": round(time.perf_counter() - tdp, 4),
+                    "staging_s": round(stage2_s, 4),
+                    "staged_bytes": cur2_bytes,
+                    "pipelined": True,
+                },
+            }
+        # Round barrier (the aggregation-tail readback round_overlap hides
+        # the pipelined work under).
         metrics_host = jax.tree_util.tree_map(np.asarray, metrics)
         variables = out_vars
         wall = time.perf_counter() - t0
@@ -1198,7 +1482,7 @@ def run_cohort_federation(
             data_fn_s=data_s,
             staging_s=staging_total,
             staged_bytes=staged_total,
-            overlapped=n_groups > 1,
+            overlapped=n_groups > 1 or pre_raw is not None,
             segments=tuple(timeline),
             max_live_staged_bytes=round_max,
             data_placement="resident" if resident else "streamed",

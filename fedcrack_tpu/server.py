@@ -46,6 +46,38 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         "cohort/phase/received): a server killed mid-round resumes the "
         "SAME round with the already-received updates intact",
     )
+    p.add_argument(
+        "--mode",
+        dest="mode",
+        help="federation mode: sync (barrier rounds, the default) or "
+        "buffered (FedBuff async aggregation — updates fold into a "
+        "K-sized staleness-weighted buffer as they arrive; no round "
+        "barrier, clients loop pull->train->push continuously)",
+    )
+    p.add_argument(
+        "--buffer-k",
+        type=int,
+        dest="buffer_k",
+        help="buffered mode: flush to a new global version after this many "
+        "accepted updates (FedBuff's K); buffer_k = cohort with "
+        "staleness-alpha 0 reproduces sync FedAvg bit-exactly",
+    )
+    p.add_argument(
+        "--staleness-alpha",
+        type=float,
+        dest="staleness_alpha",
+        help="buffered mode: polynomial staleness decay exponent — an "
+        "update s versions stale weighs ns * (1+s)^-alpha (FedAsync); "
+        "0 disables decay",
+    )
+    p.add_argument(
+        "--max-staleness",
+        type=int,
+        dest="max_staleness",
+        help="buffered mode: updates staler than this many versions are "
+        "rejected into the history and the sender re-synced; also bounds "
+        "the retained past-broadcast window for delta decode",
+    )
     p.add_argument("--fedprox-mu", type=float, dest="fedprox_mu")
     p.add_argument(
         "--pos-weight",
@@ -185,6 +217,10 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         ("round_deadline_s", "round_deadline_s"),
         ("quorum_fraction", "quorum_fraction"),
         ("state_path", "state_path"),
+        ("mode", "mode"),
+        ("buffer_k", "buffer_k"),
+        ("staleness_alpha", "staleness_alpha"),
+        ("max_staleness", "max_staleness"),
         ("fedprox_mu", "fedprox_mu"),
         ("pos_weight", "pos_weight"),
         ("server_optimizer", "server_optimizer"),

@@ -356,6 +356,333 @@ def run_edge_crash_drill(workdir: str | None = None) -> dict:
             ctx.cleanup()
 
 
+def _poll(cname: str, model_version: int, rnd: int):
+    from fedcrack_tpu.transport import transport_pb2 as pb
+
+    msg = pb.ClientMessage(cname=cname)
+    msg.poll.model_version = model_version
+    msg.poll.round = rnd
+    return msg
+
+
+def _pull(cname: str):
+    from fedcrack_tpu.transport import transport_pb2 as pb
+
+    msg = pb.ClientMessage(cname=cname)
+    msg.pull.SetInParent()
+    return msg
+
+
+def run_straggler_storm_drill(
+    seed: int = 0,
+    n_clients: int = 6,
+    versions: int = 3,
+    buffer_k: int = 2,
+    staleness_alpha: float = 0.5,
+) -> dict:
+    """STRAGGLER_STORM drill (round 14): the sync-vs-buffered A/B under ONE
+    seeded heavy-tail delay schedule (``FaultPlan.storm`` — both arms
+    replay the identical per-(client, iteration) delays).
+
+    - SYNC arm: the barrier round machine; every round's wall is the
+      cohort's MAX delay (the failure mode the async plane exists for).
+    - BUFFERED arm: FedBuff — the server flushes on the ``buffer_k``
+      fastest arrivals, staleness-weighting the stragglers' late updates
+      instead of waiting on them.
+
+    Decision metrics (the ROADMAP async item's): sustained accepted
+    updates/sec and global versions/min at EQUAL WALL — the sync arm runs
+    ``versions`` barrier rounds, then the buffered arm runs for that same
+    wall-clock window and we count what it ingested/flushed in it (a
+    buffered server never idles waiting on a straggler, so equal-versions
+    would cap its throughput at K x versions while the stragglers are
+    still sleeping — "sustained" is a rate, measured over a window). The
+    returned artifact carries both arms plus the strict comparison bools
+    the acceptance gate reads."""
+    import threading
+
+    from fedcrack_tpu.chaos.plan import (
+        STRAGGLER_DELAY,
+        STRAGGLER_STORM,
+        FaultPlan,
+    )
+    from fedcrack_tpu.fed.buffered import async_summary
+    from fedcrack_tpu.transport.codec import decode_scalar_map
+    from fedcrack_tpu.transport.service import FedServer, ServerThread
+
+    names = [f"c{i}" for i in range(n_clients)]
+    # One schedule, two arms: the delay dicts are read WITHOUT consuming
+    # (plan.take is single-threaded-per-target; N drill threads share the
+    # schedule), the storm MARKER is consumed so `triggered` proves the
+    # storm actually fired.
+    plan = FaultPlan.storm(
+        seed,
+        clients=names,
+        n_iterations=versions * 4,
+        # Heavy enough that the per-round MAX over the cohort (what the
+        # sync barrier serializes on) dwarfs the K fastest draws (what a
+        # buffered flush waits for) — the regime the async plane targets.
+        tail_alpha=1.1,
+        scale_s=0.03,
+        cap_s=0.8,
+    )
+    assert plan.take(STRAGGLER_STORM, round=1) is not None
+    delays = {
+        (f.client, f.round): f.delay_s
+        for f in plan.pending
+        if f.kind == STRAGGLER_DELAY
+    }
+
+    def run_sync() -> dict:
+        cfg = FedConfig(
+            max_rounds=versions,
+            cohort_size=n_clients,
+            registration_window_s=5.0,
+            round_deadline_s=60.0,
+            port=0,
+        )
+        server = FedServer(cfg, _vars(0.0), tick_period_s=0.02)
+        errors: list[str] = []
+
+        def client(name: str):
+            channel, call = _raw_caller(server_thread.port)
+            try:
+                assert call(_ready(name)).status == R.SW
+                rnd, mv = 1, 0
+                for it in range(1, versions + 1):
+                    time.sleep(delays[(name, it)])
+                    rep = call(_done(name, rnd, 1.0 + it, 10))
+                    if rep.status == R.RESP_ACY:
+                        # The barrier: poll until the round closes behind
+                        # the slowest client.
+                        while True:
+                            time.sleep(0.01)
+                            rep = call(_poll(name, mv, rnd))
+                            if rep.status != R.WAIT:
+                                break
+                    if rep.status == R.FIN:
+                        return
+                    c = decode_scalar_map(rep.config)
+                    rnd, mv = int(c["current_round"]), int(c["model_version"])
+            except Exception as e:  # surfaced in the artifact, never silent
+                errors.append(f"{name}: {e!r}")
+            finally:
+                channel.close()
+
+        t0 = time.perf_counter()
+        with ServerThread(server) as server_thread:
+            threads = [
+                threading.Thread(target=client, args=(n,)) for n in names
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            wall = time.perf_counter() - t0
+            state = server_thread.state
+        accepted = sum(len(h["clients"]) for h in state.history)
+        return {
+            "wall_s": round(wall, 4),
+            "accepted_updates": int(accepted),
+            "global_versions": int(state.model_version),
+            "updates_per_sec": round(accepted / wall, 3),
+            "versions_per_min": round(state.model_version / wall * 60.0, 3),
+            "errors": errors,
+        }
+
+    def run_buffered(window_s: float) -> dict:
+        cfg = FedConfig(
+            # A horizon the window can never reach: the drill measures the
+            # SUSTAINED rate over `window_s`, not time-to-N-versions.
+            max_rounds=100_000,
+            cohort_size=n_clients,
+            mode="buffered",
+            buffer_k=buffer_k,
+            staleness_alpha=staleness_alpha,
+            max_staleness=8,
+            registration_window_s=5.0,
+            round_deadline_s=60.0,
+            port=0,
+        )
+        server = FedServer(cfg, _vars(0.0), tick_period_s=0.02)
+        errors: list[str] = []
+        stop = threading.Event()
+        n_sched = versions * 4
+
+        def client(name: str):
+            channel, call = _raw_caller(server_thread.port)
+            try:
+                assert call(_ready(name)).status == R.SW
+                it = 0
+                while not stop.is_set():
+                    it += 1
+                    rep = call(_pull(name))
+                    c = decode_scalar_map(rep.config)
+                    # The same schedule, consumed cyclically past the sync
+                    # arm's horizon (the window outlives `versions`
+                    # iterations for fast clients — that is the point).
+                    time.sleep(delays[(name, (it - 1) % n_sched + 1)])
+                    if stop.is_set():
+                        return
+                    call(_done(name, int(c["current_round"]), 1.0 + it, 10))
+            except Exception as e:
+                errors.append(f"{name}: {e!r}")
+            finally:
+                channel.close()
+
+        t0 = time.perf_counter()
+        with ServerThread(server) as server_thread:
+            threads = [
+                threading.Thread(target=client, args=(n,)) for n in names
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(window_s)
+            # Snapshot AT the window edge: in-flight sleeps past it must
+            # not count (the rates divide by window_s).
+            state = server_thread.state
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+        summary = async_summary(state.history)
+        accepted = int(summary["accepted_updates"]) + len(state.buffer)
+        return {
+            "wall_s": round(window_s, 4),
+            "accepted_updates": accepted,
+            "global_versions": int(state.model_version),
+            "updates_per_sec": round(accepted / window_s, 3),
+            "versions_per_min": round(state.model_version / window_s * 60.0, 3),
+            "staleness": summary["staleness"],
+            "mean_buffer_fill": summary["mean_buffer_fill"],
+            "errors": errors,
+        }
+
+    sync = run_sync()
+    buffered = run_buffered(sync["wall_s"])
+    return {
+        "seed": seed,
+        "n_clients": n_clients,
+        "versions": versions,
+        "buffer_k": buffer_k,
+        "staleness_alpha": staleness_alpha,
+        "storm_fired": [f.kind for f in plan.triggered] == [STRAGGLER_STORM],
+        "sync": sync,
+        "buffered": buffered,
+        # The ROADMAP decision points, read by the acceptance gate: same
+        # fault plan, strictly more sustained updates/sec AND global
+        # versions/min in buffered mode.
+        "buffered_gt_sync_updates_per_sec": (
+            buffered["updates_per_sec"] > sync["updates_per_sec"]
+        ),
+        "buffered_gt_sync_versions_per_min": (
+            buffered["versions_per_min"] > sync["versions_per_min"]
+        ),
+    }
+
+
+def run_buffered_kill_drill(workdir: str | None = None) -> dict:
+    """Buffered-mode mid-BUFFER server kill→restart drill (round 14): a
+    3-client buffered federation (``buffer_k=3``), two of three updates
+    accepted into the buffer, server KILLED with zero grace, restarted
+    over the same statefile, third update delivered — the flush must land
+    on the BIT-IDENTICAL next global version an unkilled twin produces
+    (same buffer contents, same sorted fold, same bytes)."""
+    from fedcrack_tpu.transport.service import FedServer, ServerThread
+
+    ctx = (
+        tempfile.TemporaryDirectory(prefix="buffered_kill_drill_")
+        if workdir is None
+        else None
+    )
+    base = ctx.name if ctx is not None else workdir
+    try:
+        def cfg_for(state_path: str) -> FedConfig:
+            return FedConfig(
+                max_rounds=1,
+                cohort_size=3,
+                mode="buffered",
+                buffer_k=3,
+                staleness_alpha=0.5,
+                max_staleness=4,
+                registration_window_s=5.0,
+                round_deadline_s=60.0,
+                port=0,
+                state_path=state_path,
+            )
+
+        def drive(call):
+            for c in ("a", "b", "c"):
+                assert call(_ready(c)).status == R.SW
+            for c in ("a", "b", "c"):
+                call(_pull(c))
+
+        # Twin 1: uninterrupted.
+        cfg1 = cfg_for(os.path.join(base, "twin.msgpack"))
+        server1 = FedServer(cfg1, _vars(0.0), tick_period_s=0.02)
+        with ServerThread(server1) as st:
+            channel, call = _raw_caller(st.port)
+            drive(call)
+            call(_done("a", 1, 1.0, 10))
+            call(_done("b", 1, 3.0, 30))
+            rep = call(_done("c", 1, 6.0, 20))
+            channel.close()
+            twin_status = rep.status
+            twin_blob = bytes(rep.weights)
+            twin_version = st.state.model_version
+
+        # Twin 2: killed mid-buffer.
+        cfg2 = cfg_for(os.path.join(base, "killed.msgpack"))
+        server2 = FedServer(cfg2, _vars(0.0), tick_period_s=0.02)
+        with ServerThread(server2) as st:
+            channel, call = _raw_caller(st.port)
+            drive(call)
+            call(_done("a", 1, 1.0, 10))
+            call(_done("b", 1, 3.0, 30))
+            channel.close()
+            # The kill must strike after both buffer entries AND c's pull
+            # record are durable (c's framed/raw base is pinned to it).
+            _wait_for_statefile(
+                cfg2.state_path,
+                cfg2,
+                lambda s: len(s.buffer) == 2 and "c" in s.pulled,
+            )
+            t_kill = time.perf_counter()
+            st.kill()
+
+        server3 = FedServer(cfg2, _vars(0.0), tick_period_s=0.02)
+        resumed = server3.state
+        t_restored = time.perf_counter()
+        resumed_mid_buffer = (
+            len(resumed.buffer) == 2
+            and sorted(e["cname"] for e in resumed.buffer) == ["a", "b"]
+            and resumed.pulled.get("c") == 0
+        )
+        if not resumed_mid_buffer:
+            raise RuntimeError(
+                f"restart did not resume the buffer: "
+                f"{[e['cname'] for e in resumed.buffer]} pulled={dict(resumed.pulled)}"
+            )
+        with ServerThread(server3) as st:
+            channel, call = _raw_caller(st.port)
+            rep = call(_done("c", 1, 6.0, 20))
+            t_recovered = time.perf_counter()
+            channel.close()
+            killed_blob = bytes(rep.weights)
+            killed_version = st.state.model_version
+        return {
+            "resumed_mid_buffer": True,
+            "twin_flush_status": twin_status,
+            "recovered_flush_status": rep.status,
+            "global_version_identical": killed_version == twin_version,
+            "global_blob_bit_identical": killed_blob == twin_blob,
+            "restore_s": round(t_restored - t_kill, 4),
+            "kill_to_recover_s": round(t_recovered - t_kill, 4),
+        }
+    finally:
+        if ctx is not None:
+            ctx.cleanup()
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--out", required=True)
@@ -366,6 +693,8 @@ def main(argv=None) -> int:
         "kill_restart": run_kill_restart_drill(rounds=args.rounds),
         "corrupt_frame": run_corrupt_frame_drill(),
         "edge_crash": run_edge_crash_drill(),
+        "straggler_storm": run_straggler_storm_drill(),
+        "buffered_kill": run_buffered_kill_drill(),
     }
     os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
     with open(args.out, "w") as f:

@@ -256,6 +256,14 @@ class FedClient:
                 client_tag=self.cname,
             )
 
+            if str(cfg.get("mode", "sync") or "sync") == "buffered":
+                # Async federation (round 14): the server runs FedBuff
+                # buffered aggregation — no round barrier to block on, so
+                # the session becomes a continuous pull→train→push loop.
+                return self._run_buffered(
+                    method, result, max_rounds=int(cfg["max_train_round"])
+                )
+
             # Phase 2: pull global weights (reference 'P', fl_client.py:99-102)
             msg = self._msg()
             msg.pull.SetInParent()
@@ -341,6 +349,77 @@ class FedClient:
                 model_version = int(cfg["model_version"])
         finally:
             channel.close()
+
+    # -- the buffered-async session (round 14) --
+
+    def _run_buffered(self, method, result: SessionResult, max_rounds: int) -> SessionResult:
+        """The FedBuff client loop: pull the current global (the reply's
+        config names the version it IS — the base the upload's delta is
+        pinned to), train, push, repeat — never waiting on a round close.
+        A ``NOT_WAIT`` push reply is the server's resync (the update was
+        too stale and will never be averaged — codec cross-round state
+        rolls back, exactly the sync straggler contract); ``REJECTED`` is
+        sanitation failing loudly; ``FIN`` carries the final global."""
+        while True:
+            msg = self._msg()
+            msg.pull.SetInParent()
+            rep = self._call(method, msg)
+            weights = rep.weights
+            pcfg = decode_scalar_map(rep.config)
+            base_version = int(pcfg.get("model_version", 0))
+            current_round = int(pcfg.get("current_round", 1))
+            if current_round > max_rounds:
+                # The federation finished between our last push and this
+                # pull: the blob IS the final global.
+                result.final_weights = weights
+                self._upload_all(method)
+                return result
+
+            if self._train_takes_hparams:
+                trained, n_samples, metrics = self.train_fn(
+                    weights, current_round, self.server_hparams
+                )
+            else:
+                trained, n_samples, metrics = self.train_fn(weights, current_round)
+
+            upload = self.codec.encode_update(
+                trained,
+                weights,
+                round=current_round,
+                base_version=base_version,
+            )
+            msg = self._msg()
+            msg.done.round = current_round
+            msg.done.weights = upload
+            msg.done.sample_count = n_samples
+            encode_scalar_map(
+                msg.done.metrics, {k: float(v) for k, v in metrics.items()}
+            )
+            rep = self._call(method, msg)
+            result.history.append(
+                {
+                    "round": current_round,
+                    "base_version": base_version,
+                    "upload_bytes": len(upload),
+                    "status": rep.status,
+                    **metrics,
+                }
+            )
+            if rep.status == R.NOT_WAIT:
+                # Resync: this upload was refused (too stale / lost base)
+                # and will never be averaged — give the codec its
+                # cross-round mass back (see the sync-path comment above).
+                self.codec.rollback_last()
+            elif rep.status == R.REJECTED:
+                raise RuntimeError(
+                    f"server rejected update: {decode_scalar_map(rep.config)}"
+                )
+            elif rep.status in (R.RESP_ACY, R.RESP_ARY):
+                result.rounds_completed += 1
+            if rep.status == R.FIN:
+                result.final_weights = rep.weights or weights
+                self._upload_all(method)
+                return result
 
     # -- chunked file upload (reference 'L', fl_client.py:35-50) --
 

@@ -275,6 +275,14 @@ class FedServer:
             state.departed,
             state.failed_rounds,
             tuple(sorted(state.rejected)),
+            # Buffered-async mode (round 14): WHICH updates sit in the
+            # buffer and WHAT each client last pulled must both be durable
+            # — a restarted server decodes the next framed delta against
+            # the pulled record, and a mid-buffer kill must resume with the
+            # accepted updates intact. Both empty in sync mode (zero extra
+            # snapshots there).
+            tuple(sorted((e["cname"], e["seq"]) for e in state.buffer)),
+            tuple(sorted(state.pulled.items())),
         )
 
     async def _apply(self, event: R.Event) -> R.Reply:

@@ -262,6 +262,49 @@ def test_comp001_frame_decode_must_feed_validate_update():
     assert "COMP001" not in rule_ids(lint(bad, path="fedcrack_tpu/tools/fx.py"))
 
 
+# ---- async-plane pack ----
+
+
+def test_async001_unsorted_iteration_in_flush_path():
+    """ASYNC001: in fed/, inside a function whose name marks the
+    buffer-flush/staleness plane, every unsorted dict-view or set
+    iteration is an ERROR — iteration order IS aggregation order there."""
+    bad = (
+        "def flush_buffer(buf):\n"
+        "    return [v for k, v in buf.items()]\n"
+    )
+    assert "ASYNC001" in rule_ids(lint(bad))
+    bad_set = (
+        "def staleness_prune(versions):\n"
+        "    keep = set(versions)\n"
+        "    out = []\n"
+        "    for v in keep:\n"
+        "        out.append(v)\n"
+        "    return out\n"
+    )
+    assert "ASYNC001" in rule_ids(lint(bad_set))
+    good = (
+        "def flush_buffer(buf):\n"
+        "    return [v for k, v in sorted(buf.items())]\n"
+    )
+    assert "ASYNC001" not in rule_ids(lint(good))
+    # A list iteration in a flush path is fine (lists carry their order).
+    list_ok = (
+        "def flush_buffer(entries):\n"
+        "    return [e for e in entries]\n"
+    )
+    assert "ASYNC001" not in rule_ids(lint(list_ok))
+    # Functions OUTSIDE the flush/buffer/staleness plane are DET004's
+    # business, not this rule's.
+    unrelated = (
+        "def summarize(d):\n"
+        "    return [v for v in d.values()]\n"
+    )
+    assert "ASYNC001" not in rule_ids(lint(unrelated))
+    # Outside fed/ the rule does not apply.
+    assert "ASYNC001" not in rule_ids(lint(bad, path="fedcrack_tpu/serve/fx.py"))
+
+
 # ---- lock-order pack (project scope: lint_modules, not lint_source) ----
 
 CYCLE_SRC = """\

@@ -461,3 +461,63 @@ def test_cohort_scale_schema_guard():
     # compact summary lists the section like any other schema section
     summary = bench.compact_summary({"detail": good})
     assert "cohort_scale" in summary["sections"]
+
+
+def test_async_federation_schema_guard():
+    """Round-14 async_federation arm: declared in DETAIL_SCHEMA, its keys
+    written by bench.py, storm arms typed, error-arm exempt."""
+    bench = _import_bench()
+    assert "async_federation" in bench.DETAIL_SCHEMA
+    assert {"storm", "sync_equivalence", "recovery", "trajectory"} <= set(
+        bench.ASYNC_FEDERATION_SCHEMA
+    )
+    assert {"updates_per_sec", "versions_per_min", "accepted_updates"} <= set(
+        bench.ASYNC_STORM_ARM_SCHEMA
+    )
+    with open(bench.__file__) as f:
+        src = f.read()
+    for key in set(bench.ASYNC_FEDERATION_SCHEMA):
+        assert f'"{key}"' in src, f"schema key {key!r} never written by bench.py"
+    arm = {
+        "wall_s": 1.0,
+        "accepted_updates": 6,
+        "global_versions": 3,
+        "updates_per_sec": 6.0,
+        "versions_per_min": 180.0,
+    }
+    good = {
+        "async_federation": {
+            "storm": {"sync": dict(arm), "buffered": dict(arm)},
+            "sync_equivalence": {"bit_identical": True},
+            "recovery": {"global_blob_bit_identical": True},
+            "trajectory": {"buffered_final_loss": 0.01},
+        }
+    }
+    assert bench.validate_detail(good) == []
+    assert bench.validate_detail({"async_federation": {"error": "boom"}}) == []
+    assert any(
+        "async_federation['recovery'] missing" in v
+        for v in bench.validate_detail(
+            {
+                "async_federation": {
+                    "storm": {"sync": dict(arm), "buffered": dict(arm)},
+                    "sync_equivalence": {},
+                    "trajectory": {},
+                }
+            }
+        )
+    )
+    # A missing or mistyped storm arm is REPORTED, never a crash.
+    bad = {
+        "async_federation": {
+            "storm": {"sync": 42, "buffered": dict(arm, updates_per_sec="x")},
+            "sync_equivalence": {},
+            "recovery": {},
+            "trajectory": {},
+        }
+    }
+    violations = bench.validate_detail(bad)
+    assert any("storm['sync']" in v for v in violations)
+    assert any("updates_per_sec" in v for v in violations)
+    summary = bench.compact_summary({"detail": good})
+    assert "async_federation" in summary["sections"]

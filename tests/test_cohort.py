@@ -162,6 +162,30 @@ def test_cohort_driver_per_group_staging(
     assert rec.max_live_staged_bytes == 2 * group_bytes
 
 
+def test_cohort_driver_round_overlap_bit_identical(
+    cohort_data, variables, cohort_round_g2
+):
+    """Round-overlap (round 14): overlapping round N+1's data/first-group
+    staging AND first-group dispatch with round N's aggregation tail is
+    pure host scheduling — weights and metrics byte-identical to the
+    unoverlapped schedule, with the pipelined group visible in the
+    consuming round's timeline."""
+    mesh, cr = cohort_round_g2
+    data_fn = lambda r: cohort_data
+    v_plain, rec_plain = run_cohort_federation(cr, variables, data_fn, 2, mesh)
+    v_pipe, rec_pipe = run_cohort_federation(
+        cr, variables, data_fn, 2, mesh, round_overlap=True
+    )
+    _assert_trees_bytes_equal(v_pipe, v_plain)
+    for rp, rq in zip(rec_plain, rec_pipe):
+        for k, leaf in rq.metrics.items():
+            np.testing.assert_array_equal(leaf, rp.metrics[k], err_msg=k)
+    assert [e["group"] for e in rec_pipe[1].segments if e.get("pipelined")] == [0]
+    assert not any(e.get("pipelined") for e in rec_pipe[0].segments)
+    # The pipelined round still stages/accounts every group.
+    assert rec_pipe[1].staged_bytes == rec_plain[1].staged_bytes
+
+
 @pytest.mark.slow
 def test_grouped_round_pads_ragged_cohort(variables):
     """C=3 on a G=2 mesh: the last group pads with an inactive zero-weight

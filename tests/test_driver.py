@@ -166,6 +166,12 @@ def test_first_round_data_required(round_fn_and_mesh):
         run_mesh_federation(round_fn, _init_vars(), _fresh_data_fn(), 0, mesh)
 
 
+# Tier-1 budget re-balance (round 14, r4/r9/r12/r13 precedent): the
+# spatial round PROGRAM's numerics stay tier-1 in test_spatial +
+# test_parallel; this is the driver-integration twin (~16 s of spatial
+# compiles) and the driver loop itself is tier-1-pinned by six other
+# tests in this module.
+@pytest.mark.slow
 def test_driver_drives_spatial_federated_round():
     """The driver's ``image_spec`` parameter composes with the
     spatially-sharded round builder: a Mesh(('clients','space')) federation

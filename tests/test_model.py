@@ -194,7 +194,12 @@ def test_layout_flags_do_not_change_params(variables):
             assert jnp.array_equal(a, b)
 
 
-@pytest.mark.parametrize("img", [128, 256])
+# 128 px (the flagship size class) stays tier-1; the 256 px
+# belt-and-suspenders variant is slow-marked (round-14 budget re-balance —
+# a second full-size forward-parity compile, same code path).
+@pytest.mark.parametrize(
+    "img", [128, pytest.param(256, marks=pytest.mark.slow)]
+)
 def test_s2d_layout_bit_exact_random_and_fixture_inputs(variables, img):
     """THE transform pin (ISSUE r6): stem_layout='s2d' + res_layout='packed'
     reproduce the reference layout's logits BIT-EXACTLY at 128 and 256 px,

@@ -135,6 +135,10 @@ def test_segments_must_divide_epochs(mesh):
         build_federated_round_segments(mesh, TINY, local_epochs=10, segments=3)
 
 
+# Tier-1 budget re-balance (round 14): donation is the MECHANISM; its
+# user-visible bound — peak staged HBM ≤ 2 slabs — stays tier-1 via the
+# max_live_staged_bytes pins in the streaming test below.
+@pytest.mark.slow
 def test_segment_carry_is_donated(mesh, data, variables, seg_round):
     """The carry buffers of segment k back segment k+1's: the split costs
     zero steady-state HBM over the monolithic scan. jax marks donated
@@ -204,6 +208,54 @@ def test_driver_segmented_streaming_matches_monolithic(mesh, variables, seg_roun
     for rec in rec_stream:
         assert 0 < rec.max_live_staged_bytes <= 2 * slab
     assert rec_stream[0].max_live_staged_bytes == 2 * slab
+
+
+def test_driver_round_overlap_bit_identical(mesh, variables, seg_round):
+    """Round-overlap (round 14): pipelining round N+1's first segment
+    under round N's aggregation tail is pure host scheduling — weights
+    AND metrics byte-identical to the unpipelined schedule, including
+    across a data_fn(r)->None buffer-reuse round, with the pipelined
+    segment visible in the consuming round's timeline."""
+
+    def reuse_data_fn():
+        fresh = _fresh_data_fn()
+
+        def data_fn(r):
+            return None if r == 2 else fresh(r)
+
+        return data_fn
+
+    v_plain, rec_plain = run_mesh_federation(
+        seg_round, variables, reuse_data_fn(), 3, mesh
+    )
+    v_pipe, rec_pipe = run_mesh_federation(
+        seg_round, variables, reuse_data_fn(), 3, mesh, round_overlap=True
+    )
+    _assert_trees_bytes_equal(v_pipe, v_plain)
+    for rp, rq in zip(rec_plain, rec_pipe):
+        _assert_trees_bytes_equal(rq.metrics, rp.metrics)
+    # Rounds 1 and 2 consumed a pre-dispatched segment 0.
+    assert [e["segment"] for e in rec_pipe[1].segments if e.get("pipelined")] == [0]
+    assert [e["segment"] for e in rec_pipe[2].segments if e.get("pipelined")] == [0]
+    assert not any(e.get("pipelined") for e in rec_pipe[0].segments)
+
+
+def test_round_overlap_contract_errors(mesh, variables, seg_round):
+    mono = build_federated_round(mesh, TINY, learning_rate=1e-3, local_epochs=2)
+    with pytest.raises(ValueError, match="SegmentedRound"):
+        run_mesh_federation(
+            mono, variables, _fresh_data_fn(), 2, mesh, round_overlap=True
+        )
+    with pytest.raises(ValueError, match="overlap_staging"):
+        run_mesh_federation(
+            seg_round, variables, _fresh_data_fn(), 2, mesh,
+            round_overlap=True, overlap_staging=False,
+        )
+    with pytest.raises(ValueError, match="max_round_retries"):
+        run_mesh_federation(
+            seg_round, variables, _fresh_data_fn(), 2, mesh,
+            round_overlap=True, max_round_retries=1,
+        )
 
 
 @pytest.mark.slow
