@@ -110,6 +110,8 @@ FEDCRACK_BENCH_SERVE_SIZES=128,256 FEDCRACK_BENCH_SERVE_REQUESTS=128
 FEDCRACK_BENCH_SERVE_MAX_BATCH=8 FEDCRACK_BENCH_SERVE_CONCURRENCY=8
 FEDCRACK_BENCH_COMPRESSION=0 (skip the update-compression A/B)
 FEDCRACK_BENCH_COMPRESSION_ROUNDS=3 (mesh-twin trajectory rounds).
+FEDCRACK_BENCH_OBSERVABILITY=0 (skip the round-15 concurrent mini-soak)
+FEDCRACK_BENCH_SOAK_S=8 (the soak's traffic wall in seconds)
 """
 
 from __future__ import annotations
@@ -166,6 +168,32 @@ DETAIL_SCHEMA: dict = {
     "update_compression": dict,
     "cohort_scale": dict,
     "async_federation": dict,
+    "observability": dict,
+}
+# Typed keys of detail.observability (round 15): the concurrent mini-soak's
+# contract — the self-scrape must cover all five instrumented planes and
+# the end-of-soak invariant audit must hold (zero torn versions, EF mass
+# conserved, bit-identical statefile restore, steady watermarks).
+OBSERVABILITY_SCHEMA: dict = {
+    "traffic_wall_s": (int, float),
+    "storm_fired": bool,
+    "federation": dict,
+    "serve": dict,
+    "scrape": dict,
+    "spans": dict,
+    "audit": dict,
+}
+# Required keys of detail.observability.audit — the gate bench readers and
+# the tier-1 guard test read.
+OBSERVABILITY_AUDIT_SCHEMA: dict = {
+    "torn_versions": int,
+    "zero_torn_versions": bool,
+    "serve_healthy": bool,
+    "ef_mass_conserved": bool,
+    "statefile_restore_bit_identical": bool,
+    "watermarks_steady": bool,
+    "recompiles_since_warmup": int,
+    "clean": bool,
 }
 # Typed keys of detail.async_federation (round 14): the buffered-async
 # contract — the chaos straggler-storm sync-vs-buffered A/B at equal wall,
@@ -319,6 +347,31 @@ def validate_detail(detail: dict) -> list:
                         f"async_federation.storm[{arm!r}][{key!r}]: "
                         f"{type(point[key]).__name__}"
                     )
+    obsy = detail.get("observability")
+    if isinstance(obsy, dict) and "error" not in obsy:
+        for key, typs in OBSERVABILITY_SCHEMA.items():
+            if key not in obsy:
+                bad.append(f"observability[{key!r}] missing")
+            elif not isinstance(obsy[key], typs):
+                bad.append(f"observability[{key!r}]: {type(obsy[key]).__name__}")
+        audit = obsy.get("audit")
+        if isinstance(audit, dict):
+            for key, typs in OBSERVABILITY_AUDIT_SCHEMA.items():
+                if key not in audit:
+                    bad.append(f"observability.audit[{key!r}] missing")
+                elif not isinstance(audit[key], typs):
+                    bad.append(
+                        f"observability.audit[{key!r}]: "
+                        f"{type(audit[key]).__name__}"
+                    )
+        scrape_block = obsy.get("scrape")
+        if isinstance(scrape_block, dict):
+            planes = scrape_block.get("planes_covered")
+            if not isinstance(planes, dict):
+                bad.append(
+                    f"observability.scrape['planes_covered']: "
+                    f"{type(planes).__name__}"
+                )
     cohort = detail.get("cohort_scale")
     if isinstance(cohort, dict) and "error" not in cohort:
         for key, typs in COHORT_SCALE_SCHEMA.items():
@@ -389,6 +442,14 @@ COHORT_TREE_FANOUT = int(os.environ.get("FEDCRACK_BENCH_COHORT_FANOUT", "32"))
 # kill→restart drill, and a deterministic equal-wall trajectory
 # simulation. "0" opts out.
 ASYNC = os.environ.get("FEDCRACK_BENCH_ASYNC", "1") == "1"
+
+# Observability section (round 15, detail.observability): the concurrent
+# mini-soak — buffered federation + edge shard + serve/hot-swap + driver
+# leg under a rolling chaos schedule, self-scraped through a live /metrics
+# endpoint, ending in the invariant audit. "0" opts out;
+# FEDCRACK_BENCH_SOAK_S sizes the traffic wall.
+OBSERVABILITY = os.environ.get("FEDCRACK_BENCH_OBSERVABILITY", "1") == "1"
+SOAK_S = float(os.environ.get("FEDCRACK_BENCH_SOAK_S", "8"))
 ASYNC_SEED = int(os.environ.get("FEDCRACK_BENCH_ASYNC_SEED", "0"))
 
 # Serving-plane SLO section (round 10, detail.serving): boots the full
@@ -2271,6 +2332,15 @@ def _bench_async_federation() -> dict:
     }
 
 
+def _bench_observability() -> dict:
+    """detail.observability (round 15): the concurrent mini-soak + its
+    end-of-soak invariant audit, self-scraped over a real /metrics HTTP
+    endpoint."""
+    from fedcrack_tpu.tools.soak import run_soak
+
+    return run_soak(duration_s=SOAK_S, seed=0)
+
+
 def main() -> None:
     # Smoke-test hook: this image pre-imports jax at interpreter startup with
     # the axon (real TPU tunnel) platform, so a JAX_PLATFORMS=cpu env override
@@ -2862,6 +2932,27 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
         else:
             _skip(
                 skips, "async_federation", 20.0, "estimate exceeds remaining budget"
+            )
+
+    # ---- observability (round 15): the concurrent mini-soak — buffered
+    # federation + edge shard + live hot-swapping serve plane + driver leg
+    # under a rolling chaos schedule (storm delays, corrupt frames, a
+    # mid-soak server kill→restart), watched through its own /metrics
+    # endpoint and closed with the invariant audit ----
+    if OBSERVABILITY:
+        obsy_est = SOAK_S + 25.0  # + tiny-engine compile & teardown
+        if _fits(obsy_est):
+            t0 = time.monotonic()
+            try:
+                detail["observability"] = _bench_observability()
+            except Exception as e:  # an in-process extra must never kill the artifact
+                detail["observability"] = {"error": repr(e)}
+            section_s["observability"] = time.monotonic() - t0
+            detail["budget"] = _budget_detail()
+            _set_payload(metric_headline, value, vs_baseline, detail)
+        else:
+            _skip(
+                skips, "observability", obsy_est, "estimate exceeds remaining budget"
             )
 
     # ---- batch-scaling curve (bf16 flagship at batch 32/64; non-parity

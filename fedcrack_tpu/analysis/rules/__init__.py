@@ -18,6 +18,7 @@ def all_rules() -> list[Rule]:
         determinism,
         durability,
         locks,
+        obs_plane,
         trace,
         transport,
     )
@@ -25,7 +26,7 @@ def all_rules() -> list[Rule]:
     out: list[Rule] = []
     for pack in (
         determinism, durability, trace, transport, compress, async_plane,
-        locks, deadcode,
+        obs_plane, locks, deadcode,
     ):
         out.extend(cls() for cls in pack.RULES)
     return out

@@ -1,0 +1,103 @@
+"""Observability rule pack (round 15).
+
+- **OBS001 non-catalog metric name**: every ``registry.counter(...)`` /
+  ``registry.gauge(...)`` / ``registry.histogram(...)`` call site must name
+  its metric with a **string literal** that is ``snake_case`` and carries a
+  unit suffix (``_seconds``, ``_bytes``, ``_total``, ``_ratio``, or
+  ``_versions`` — the staleness unit). Two failure modes this kills:
+
+  * a *computed* name (f-string, variable, concatenation) makes the metric
+    catalog ungreppable — ``grep -r fed_updates_total`` must find every
+    producer — and lets label-like variance leak into the name (unbounded
+    series, broken dashboards);
+  * a free-spelled name (``FedUpdates``, ``updates_count``, no unit) makes
+    the Prometheus exposition drift from the documented catalog; the
+    registry enforces the same contract at runtime
+    (``obs.registry.validate_metric_name``), this rule catches it before
+    anything runs.
+
+  The receiver is matched by NAME — a variable/attribute called
+  ``registry``/``REGISTRY`` (or containing ``registry``) or the
+  conventional short alias ``reg`` — so the rule follows the idiom, not
+  the import graph. Calls that pass the name via ``name=`` keyword are
+  checked the same way.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from fedcrack_tpu.analysis.engine import Finding, ModuleSource, Rule, Severity
+
+METRIC_METHODS = ("counter", "gauge", "histogram")
+NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_ratio", "_versions")
+
+
+def _registry_receiver(call: ast.Call) -> bool:
+    func = call.func
+    if not isinstance(func, ast.Attribute) or func.attr not in METRIC_METHODS:
+        return False
+    recv = func.value
+    if isinstance(recv, ast.Name):
+        name = recv.id
+    elif isinstance(recv, ast.Attribute):
+        name = recv.attr
+    else:
+        return False
+    low = name.lower()
+    return "registry" in low or low in ("reg", "_reg")
+
+
+def _name_arg(call: ast.Call) -> ast.expr | None:
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "name":
+            return kw.value
+    return None
+
+
+class MetricCatalogNameRule(Rule):
+    id = "OBS001"
+    severity = Severity.ERROR
+    description = (
+        "registry.counter/gauge/histogram metric name must be a snake_case "
+        "string literal with a unit suffix (_seconds/_bytes/_total/_ratio/"
+        "_versions) — computed or free-spelled names break the greppable "
+        "catalog and the exposition's stability"
+    )
+
+    def check(self, module: ModuleSource) -> Iterable[Finding]:
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call) and _registry_receiver(node)):
+                continue
+            arg = _name_arg(node)
+            if arg is None:
+                yield self.finding(module, node, "metric call without a name argument")
+                continue
+            if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+                yield self.finding(
+                    module,
+                    arg if hasattr(arg, "lineno") else node,
+                    "metric name must be a string LITERAL (computed names "
+                    "make the catalog ungreppable and can mint unbounded "
+                    "series)",
+                )
+                continue
+            name = arg.value
+            if not NAME_RE.match(name):
+                yield self.finding(
+                    module, arg,
+                    f"metric name {name!r} is not snake_case ([a-z][a-z0-9_]*)",
+                )
+            elif not name.endswith(UNIT_SUFFIXES):
+                yield self.finding(
+                    module, arg,
+                    f"metric name {name!r} lacks a unit suffix {UNIT_SUFFIXES}",
+                )
+
+
+RULES = (MetricCatalogNameRule,)

@@ -47,8 +47,26 @@ from fedcrack_tpu.fed.algorithms import fedavg, sample_cohort
 from fedcrack_tpu.fed.rounds import decode_and_validate_update, quorum_target
 from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
 from fedcrack_tpu.ioutils import atomic_write_bytes
+from fedcrack_tpu.obs.registry import REGISTRY
 
 log = logging.getLogger("fedcrack.fed.tree")
+
+
+def _edge_updates_counter():
+    return REGISTRY.counter(
+        "edge_updates_total",
+        "leaf uploads at the edge tier by outcome",
+        labels=("result",),
+    )
+
+
+def _edge_wire_counter():
+    return REGISTRY.counter(
+        "edge_wire_bytes_total",
+        "wire bytes at the edge tier (in = leaf uploads, up = partials "
+        "pushed toward the root)",
+        labels=("direction",),
+    )
 
 EDGE_STATE_FORMAT = 1
 
@@ -254,10 +272,13 @@ class EdgeAggregator:
             sanitize=self.sanitize,
         )
         self.bytes_in += wire_len
+        _edge_wire_counter().labels(direction="in").inc(wire_len)
         if problem is not None:
             self.rejected[cname] = problem
+            _edge_updates_counter().labels(result="rejected").inc()
             self._persist()
             return False, problem
+        _edge_updates_counter().labels(result="accepted").inc()
         self.received[cname] = (decoded, int(num_samples))
         self.wire_bytes[cname] = wire_len
         self.peak_resident_blobs = max(self.peak_resident_blobs, len(self.received))
@@ -302,8 +323,10 @@ class EdgeAggregator:
             sanitize=self.sanitize,
         )
         self.bytes_in += wire_len
+        _edge_wire_counter().labels(direction="in").inc(wire_len)
         if problem is not None:
             return self._refuse(cname, problem)
+        _edge_updates_counter().labels(result="accepted").inc()
         self.buffer.append(
             {
                 "cname": cname,
@@ -325,6 +348,7 @@ class EdgeAggregator:
 
     def _refuse(self, cname: str, reason: str) -> tuple[bool, str]:
         self.rejected[cname] = reason
+        _edge_updates_counter().labels(result="rejected").inc()
         self._persist()
         return False, reason
 
@@ -378,6 +402,10 @@ class EdgeAggregator:
                 **kwargs,
             )
         self.bytes_up += len(blob)
+        _edge_wire_counter().labels(direction="up").inc(len(blob))
+        REGISTRY.counter(
+            "edge_flushes_total", "edge-tier partial aggregations pushed up"
+        ).inc()
         info = {
             "clients": [e["cname"] for e in entries],
             "staleness": [e["staleness"] for e in entries],
@@ -427,6 +455,10 @@ class EdgeAggregator:
                 base_version=self.base_version,
             )
         self.bytes_up += len(blob)
+        _edge_wire_counter().labels(direction="up").inc(len(blob))
+        REGISTRY.counter(
+            "edge_flushes_total", "edge-tier partial aggregations pushed up"
+        ).inc()
         return blob, total
 
     def end_round(self) -> None:

@@ -6,6 +6,11 @@ plus a per-round TensorBoard callback whose upload path is commented out
 Here both planes emit structured JSONL records — per-round loss/IoU,
 wall-clock, and bytes moved on the control plane — and ``jax.profiler``
 traces can wrap any training span for TPU timeline inspection.
+
+Round 15 adds the live telemetry plane: a thread-safe metric registry with
+one catalog across all planes (``registry``), Prometheus text-format
+exposition over HTTP (``promexp``), correlated trace spans (``spans``) and
+RSS/device-memory leak sentries (``sentries``).
 """
 
 from fedcrack_tpu.obs.flops import (
@@ -20,11 +25,31 @@ from fedcrack_tpu.obs.metrics import (
     read_metrics,
     stopwatch,
 )
+from fedcrack_tpu.obs.promexp import (
+    MetricsExporter,
+    parse_prometheus_text,
+    scrape,
+    start_exporter,
+)
+from fedcrack_tpu.obs.registry import REGISTRY, MetricsRegistry
+from fedcrack_tpu.obs.sentries import LeakError, LeakSentry
+from fedcrack_tpu.obs.spans import SpanRecorder, read_spans, span
 from fedcrack_tpu.obs.tb import SummaryWriter, read_histograms, read_scalars
 
 __all__ = [
+    "LeakError",
+    "LeakSentry",
+    "MetricsExporter",
     "MetricsLogger",
+    "MetricsRegistry",
+    "REGISTRY",
+    "SpanRecorder",
     "SummaryWriter",
+    "parse_prometheus_text",
+    "read_spans",
+    "scrape",
+    "span",
+    "start_exporter",
     "read_histograms",
     "device_peak_flops",
     "mfu",

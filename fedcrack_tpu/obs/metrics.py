@@ -206,6 +206,61 @@ class StreamingPercentiles:
     def count(self) -> int:
         return self._count
 
+    def merge(self, other: "StreamingPercentiles") -> "StreamingPercentiles":
+        """Fold ``other``'s reservoir into this one — the cross-replica /
+        cross-plane aggregation a multi-replica serve fleet needs (each
+        replica keeps its own reservoir; the fleet view is the merge).
+
+        Semantics (seeded, order-pinned — test-pinned):
+
+        - count/sum/min/max merge EXACTLY, whatever the reservoir does;
+        - while the combined sample fits ``capacity``, the merged reservoir
+          is the concatenation (self's values then other's) — percentiles
+          stay EXACTLY ``numpy.percentile`` of the pooled samples;
+        - past capacity, each retained value represents ``seen/len``
+          stream items; the merge keeps a weighted sample without
+          replacement via Efraimidis–Spirakis keys (``u ** (1/w)``) drawn
+          from SELF's rng over the pinned order (self's reservoir then
+          other's) — deterministic for a given (seed, call sequence), and
+          each side contributes ~proportionally to how much stream it saw.
+
+        ``other`` is snapshotted under its own lock FIRST, then self is
+        updated under its lock — sequential leaf acquisitions, so
+        concurrent ``a.merge(b)`` / ``b.merge(a)`` cannot deadlock.
+        Returns ``self`` for chaining.
+        """
+        if other is self:
+            raise ValueError("merge(self) would double-count the reservoir")
+        with other._lock:
+            o_values = list(other._values)
+            o_count, o_sum = other._count, other._sum
+            o_min, o_max = other._min, other._max
+        if o_count == 0:
+            return self
+        with self._lock:
+            s_len = len(self._values)
+            if self._count + o_count <= self._capacity:
+                self._values.extend(o_values)
+            else:
+                weighted = []
+                if s_len:
+                    w_self = self._count / s_len
+                    weighted += [(v, w_self) for v in self._values]
+                w_other = o_count / len(o_values)
+                weighted += [(v, w_other) for v in o_values]
+                keyed = [
+                    (self._rng.random() ** (1.0 / w), v) for v, w in weighted
+                ]
+                keyed.sort(key=lambda kv: (-kv[0], kv[1]))
+                self._values = [v for _, v in keyed[: self._capacity]]
+            self._count += o_count
+            self._sum += o_sum
+            if o_min is not None:
+                self._min = o_min if self._min is None else min(self._min, o_min)
+            if o_max is not None:
+                self._max = o_max if self._max is None else max(self._max, o_max)
+        return self
+
     def percentile(self, q: float) -> float | None:
         """numpy.percentile(..., method='linear') over the reservoir; None
         while empty."""

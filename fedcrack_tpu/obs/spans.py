@@ -1,0 +1,186 @@
+"""Dapper-style trace spans — correlated JSONL timelines across planes.
+
+A span is one named interval with a **trace id** (the correlation key: a
+federation round, a serve request) and an optional **parent span id**, so a
+multi-plane session can be reconstructed as a tree instead of interleaved
+log lines: ``round-3`` owns the driver's dispatch span, the tree edge's
+flush span and the transport pushes it correlates; ``req-000042`` owns the
+serve front door's request span, the batch it rode and the swap that
+installed mid-flight.
+
+Recording follows the repo's sanitizer idiom (``make_lock`` /
+``install_monitor``): instrumentation calls the module-level
+:func:`span` context manager unconditionally — it is a **no-op costing one
+global read** until a recorder is installed (:func:`install`, or a
+:class:`SpanRecorder` passed explicitly). Durations come from the
+monotonic clock; the wall clock appears only as the display-only ``ts``
+field, per the obs JSONL convention ("t" = monotonic offset there too).
+
+Record shape (one JSON object per line)::
+
+    {"name": "serve.batch", "trace": "req-000042", "span": 17,
+     "parent": 12, "t": 3.104, "dur_s": 0.0021, "ts": 1789... ,
+     "bucket": 128}
+
+Span ids are a per-recorder sequence — deterministic for a deterministic
+schedule, merely unique otherwise.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import time
+from typing import Any, Iterator
+
+from fedcrack_tpu.analysis.sanitizers import make_lock
+
+
+class SpanHandle:
+    """What a ``with span(...)`` body sees: the ids to thread to children."""
+
+    __slots__ = ("span_id", "trace", "attrs")
+
+    def __init__(self, span_id: int, trace: str | None):
+        self.span_id = span_id
+        self.trace = trace
+        self.attrs: dict[str, Any] = {}
+
+    def set(self, **attrs: Any) -> None:
+        """Attach attributes discovered mid-span (e.g. the model version a
+        batch was answered from)."""
+        self.attrs.update(attrs)
+
+
+class SpanRecorder:
+    """Append-only JSONL span sink; thread-safe."""
+
+    def __init__(self, path: str | os.PathLike | io.TextIOBase):
+        if isinstance(path, io.TextIOBase):
+            self._f = path
+            self._owns = False
+        else:
+            p = os.fspath(path)
+            parent = os.path.dirname(os.path.abspath(p))
+            os.makedirs(parent, exist_ok=True)
+            self._f = open(p, "a", encoding="utf-8")
+            self._owns = True
+        self._lock = make_lock("obs.spans.sink")
+        self._t0 = time.monotonic()
+        self._seq = 0
+
+    def _next_id(self) -> int:
+        with self._lock:
+            self._seq += 1
+            return self._seq
+
+    @contextlib.contextmanager
+    def span(
+        self,
+        name: str,
+        *,
+        trace: str | None = None,
+        parent: int | None = None,
+        **attrs: Any,
+    ) -> Iterator[SpanHandle]:
+        handle = SpanHandle(self._next_id(), trace)
+        t_start = time.monotonic()
+        try:
+            yield handle
+        finally:
+            dur = time.monotonic() - t_start
+            record: dict[str, Any] = {
+                "name": name,
+                "trace": trace,
+                "span": handle.span_id,
+                "parent": parent,
+                "t": round(t_start - self._t0, 6),
+                "dur_s": round(dur, 6),
+                # Interval math above is monotonic; the wall clock is the
+                # display-only "ts" field (obs JSONL convention).
+                # fedlint: disable=DET001 -- human-readable record timestamp
+                "ts": time.time(),
+            }
+            for k, v in attrs.items():
+                record[k] = v
+            for k, v in handle.attrs.items():
+                record[k] = v
+            line = json.dumps(record, sort_keys=True, default=str)
+            with self._lock:
+                self._f.write(line + "\n")
+                self._f.flush()
+
+    def close(self) -> None:
+        if self._owns:
+            with self._lock:
+                self._f.close()
+
+    def __enter__(self) -> "SpanRecorder":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+# ---- the module-level recorder (sanitizer idiom: zero-cost when off) ----
+
+_recorder: SpanRecorder | None = None
+_recorder_lock = make_lock("obs.spans.install")
+
+
+def install(path: str | os.PathLike | io.TextIOBase) -> SpanRecorder:
+    """Install the process span recorder; returns it. Replacing an existing
+    recorder closes the old one."""
+    global _recorder
+    rec = SpanRecorder(path)
+    with _recorder_lock:
+        old, _recorder = _recorder, rec
+    if old is not None:
+        old.close()
+    return rec
+
+
+def uninstall() -> None:
+    global _recorder
+    with _recorder_lock:
+        old, _recorder = _recorder, None
+    if old is not None:
+        old.close()
+
+
+def current() -> SpanRecorder | None:
+    return _recorder
+
+
+@contextlib.contextmanager
+def span(
+    name: str,
+    *,
+    trace: str | None = None,
+    parent: int | None = None,
+    **attrs: Any,
+) -> Iterator[SpanHandle | None]:
+    """Record ``name`` against the installed recorder; a no-op (yielding
+    ``None``) when none is installed — instrumentation sites never branch."""
+    rec = _recorder
+    if rec is None:
+        yield None
+        return
+    with rec.span(name, trace=trace, parent=parent, **attrs) as handle:
+        yield handle
+
+
+def read_spans(path: str | os.PathLike, name: str | None = None) -> list[dict]:
+    """Load a span JSONL, optionally filtered by span name."""
+    out = []
+    with open(os.fspath(path), encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if name is None or rec.get("name") == name:
+                out.append(rec)
+    return out

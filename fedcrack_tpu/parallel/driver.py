@@ -77,6 +77,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fedcrack_tpu.data.pipeline import SamplePool, split_epoch_slab
+from fedcrack_tpu.obs import spans as tracing
+from fedcrack_tpu.obs.registry import REGISTRY
 from fedcrack_tpu.parallel.fedavg_mesh import (
     CohortRound,
     SegmentedRound,
@@ -84,6 +86,50 @@ from fedcrack_tpu.parallel.fedavg_mesh import (
 )
 
 CLIENTS, BATCH = "clients", "batch"
+
+
+def _observe_round_record(record: "RoundRecord", sentry: Any = None) -> None:
+    """Project one RoundRecord into the metric registry (the mesh/driver
+    plane of the r15 catalog) and emit its correlation span. Purely
+    additive: the record stays the artifact of truth, the registry is the
+    live view a scrape sees mid-session."""
+    REGISTRY.counter(
+        "driver_rounds_total", "mesh federated rounds driven to their barrier"
+    ).inc()
+    REGISTRY.histogram(
+        "driver_round_seconds",
+        "host wall clock of one driven round (dispatch to barrier)",
+        buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0),
+    ).observe(record.wall_clock_s)
+    REGISTRY.counter(
+        "driver_staged_bytes_total",
+        "host->device bytes newly staged for driven rounds",
+    ).inc(max(0, record.staged_bytes))
+    REGISTRY.gauge(
+        "driver_live_staged_bytes",
+        "peak concurrently-staged driver bytes in the latest round",
+    ).set(record.max_live_staged_bytes)
+    if record.bytes_per_round:
+        REGISTRY.counter(
+            "driver_wire_bytes_total",
+            "modeled update wire bytes for driven rounds (codec-priced)",
+        ).inc(record.bytes_per_round)
+    if sentry is not None:
+        REGISTRY.gauge(
+            "driver_recompiles_total",
+            "RecompileSentry deltas since its mark over the driver's "
+            "watched round programs (steady-state contract: 0)",
+        ).set(sum(sentry.deltas().values()))
+    with tracing.span(
+        "driver.round",
+        trace=f"round-{record.round_idx}",
+        wall_s=round(record.wall_clock_s, 6),
+        staging_s=round(record.staging_s, 6),
+        staged_bytes=int(record.staged_bytes),
+        retries=int(record.retries),
+        data_placement=record.data_placement,
+    ):
+        pass
 
 
 @dataclasses.dataclass
@@ -625,6 +671,7 @@ def run_mesh_federation(
     history: Sequence[dict] = (),
     max_round_retries: int = 0,
     fault_injector: Callable[[int, int], Any] | None = None,
+    recompile_sentry: Any | None = None,
 ) -> tuple[Any, list[RoundRecord]]:
     """Drive federated rounds ``start_round .. n_rounds-1`` through
     ``round_fn``.
@@ -1128,6 +1175,7 @@ def run_mesh_federation(
             bytes_per_round=bytes_per_round,
         )
         records.append(record)
+        _observe_round_record(record, sentry=recompile_sentry)
         if on_round is not None:
             on_round(record, variables)
         if checkpointer is not None:
@@ -1279,6 +1327,7 @@ def run_cohort_federation(
     image_spec: P | None = None,
     round_overlap: bool = False,
     on_round: Callable[[RoundRecord, Any], None] | None = None,
+    recompile_sentry: Any | None = None,
 ) -> tuple[Any, list[RoundRecord]]:
     """Drive a time-multiplexed cohort federation (round 13): each round's
     C-client cohort executes as ``ceil(C / G)`` sequential group dispatches
@@ -1488,6 +1537,7 @@ def run_cohort_federation(
             data_placement="resident" if resident else "streamed",
         )
         records.append(record)
+        _observe_round_record(record, sentry=recompile_sentry)
         if on_round is not None:
             on_round(record, variables)
     return variables, records

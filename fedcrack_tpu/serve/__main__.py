@@ -38,6 +38,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--swap-poll-s", type=float)
     p.add_argument("--compute-dtype", choices=["float32", "bfloat16"])
     p.add_argument("--metrics-path", help="JSONL metrics sink (serve_batch/serve_swap)")
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=0,
+        help="Prometheus /metrics endpoint for the live registry (request "
+        "latency per bucket, queue depth, deadline misses, swaps, "
+        "recompiles); 0 disables, -1 binds an ephemeral port",
+    )
+    p.add_argument(
+        "--spans-path",
+        help="JSONL trace-span sink (serve.batch/serve.swap correlation "
+        "spans); empty disables",
+    )
     p.add_argument("--seed", type=int, default=0, help="init seed when no weights found")
     return p
 
@@ -137,6 +150,17 @@ async def _serve(args) -> int:
         metrics=metrics,
     )
     engine.warmup(manager.snapshot()[1])
+    # Live telemetry (round 15): /metrics exporter + post-warmup recompile
+    # sentry (serve_recompiles_total must stay 0 across hot swaps) + spans.
+    from fedcrack_tpu.obs.promexp import start_exporter
+    from fedcrack_tpu.serve.engine import watch_recompiles
+
+    watch_recompiles(engine)
+    exporter = start_exporter(args.metrics_port)
+    if args.spans_path:
+        from fedcrack_tpu.obs import spans as tracing
+
+        tracing.install(args.spans_path)
     batcher = MicroBatcher(engine, manager, metrics=metrics)
     server = ServeServer(
         ServeService(engine, batcher, manager),
@@ -146,10 +170,14 @@ async def _serve(args) -> int:
     )
     manager.start()
     port = await server.start()
+    metrics_note = (
+        f" metrics_port={exporter.bound_port}" if exporter is not None else ""
+    )
     print(
         f"SERVING {serve_config.host}:{port} "
         f"buckets={','.join(str(s) for s in serve_config.bucket_sizes)} "
-        f"max_batch={serve_config.max_batch} version={manager.version}",
+        f"max_batch={serve_config.max_batch} version={manager.version}"
+        f"{metrics_note}",
         flush=True,
     )
 
@@ -164,6 +192,8 @@ async def _serve(args) -> int:
     await server.stop()
     manager.stop()
     batcher.close()
+    if exporter is not None:
+        exporter.stop()
     if metrics is not None:
         import json
 

@@ -40,7 +40,9 @@ from typing import Any
 import numpy as np
 
 from fedcrack_tpu.analysis.sanitizers import make_lock
+from fedcrack_tpu.obs import spans as tracing
 from fedcrack_tpu.obs.metrics import StreamingPercentiles
+from fedcrack_tpu.obs.registry import REGISTRY
 
 # Bounded batch retries under injected/real device failures: a request is
 # only failed (never silently dropped) when every attempt raised.
@@ -63,6 +65,7 @@ class _Request:
     image: np.ndarray          # [S, S, 3] uint8, already bucket-shaped
     t_submit: float
     deadline_s: float | None   # absolute monotonic deadline, None = none
+    trace: str = ""            # correlation id (req-NNNNNN) for span joins
     future: Future = field(default_factory=Future)
 
 
@@ -119,6 +122,42 @@ class MicroBatcher:
         }
         self._per_bucket: dict[int, int] = {s: 0 for s in engine.bucket_sizes}
         self._versions_served: dict[int, int] = {}
+        # Registry families cached once (the catalog names are literals per
+        # OBS001); per-request updates are then one leaf-lock bump each.
+        self._m_requests = REGISTRY.counter(
+            "serve_requests_total",
+            "requests completed per bucket program",
+            labels=("bucket",),
+        )
+        self._m_latency = REGISTRY.histogram(
+            "serve_request_seconds",
+            "submit-to-answer latency per bucket (queue + dispatch)",
+            labels=("bucket",),
+        )
+        self._m_queue_wait = REGISTRY.histogram(
+            "serve_queue_seconds",
+            "submit-to-dispatch queue wait per bucket",
+            labels=("bucket",),
+        )
+        self._m_deadline = REGISTRY.counter(
+            "serve_deadline_missed_total",
+            "requests answered past their deadline (served, never dropped)",
+        )
+        self._m_batches = REGISTRY.counter(
+            "serve_batches_total", "dispatched micro-batches"
+        )
+        self._m_retries = REGISTRY.counter(
+            "serve_batch_retries_total",
+            "batch dispatch retries after a (possibly injected) failure",
+        )
+        self._m_failed = REGISTRY.counter(
+            "serve_failed_requests_total",
+            "requests failed loudly after every batch attempt raised",
+        )
+        self._m_qdepth = REGISTRY.gauge(
+            "serve_queue_depth_total",
+            "requests waiting across all bucket queues",
+        )
         self._last_batch_end: float | None = None
         self._last_version: int | None = None
         self.swap_gaps_ms: list[float] = []
@@ -155,7 +194,9 @@ class MicroBatcher:
         )
         with self._lock:
             self._counts["submitted"] += 1
+            req.trace = f"req-{self._counts['submitted']:06d}"
         self._queues[h].put(req)
+        self._m_qdepth.set(sum(q.qsize() for q in self._queues.values()))
         return req.future
 
     # ---- the per-bucket worker ----
@@ -207,21 +248,36 @@ class MicroBatcher:
                     last_err = e
                     with self._lock:
                         self._counts["batch_retries"] += 1
+                    self._m_retries.inc()
                     continue
             try:
-                t0 = time.monotonic()
-                probs = self.engine.predict_bucket(variables, images)
-                t1 = time.monotonic()
+                # One span per dispatched batch, joined to its requests by
+                # their req-NNNNNN correlation ids and to the swap plane by
+                # model_version.
+                with tracing.span(
+                    "serve.batch",
+                    trace=f"bucket-{size}",
+                    bucket=size,
+                    n=len(batch),
+                    attempt=attempt,
+                    model_version=version,
+                    requests=[r.trace for r in batch],
+                ):
+                    t0 = time.monotonic()
+                    probs = self.engine.predict_bucket(variables, images)
+                    t1 = time.monotonic()
             except Exception as e:
                 last_err = e
                 with self._lock:
                     self._counts["batch_retries"] += 1
+                self._m_retries.inc()
                 continue
             self._resolve(batch, probs, version, t0, t1, size)
             return
         # Every attempt failed: requests error out loudly, never hang.
         with self._lock:
             self._counts["failed"] += len(batch)
+        self._m_failed.inc(len(batch))
         for r in batch:
             r.future.set_exception(
                 last_err if last_err is not None else RuntimeError("batch failed")
@@ -244,6 +300,12 @@ class MicroBatcher:
                 self.swap_gaps_ms.append(max(0.0, gap))
             self._last_version = version
             self._last_batch_end = t1
+        bucket_lbl = str(size)
+        m_latency = self._m_latency.labels(bucket=bucket_lbl)
+        m_queue = self._m_queue_wait.labels(bucket=bucket_lbl)
+        self._m_requests.labels(bucket=bucket_lbl).inc(len(batch))
+        self._m_batches.inc()
+        self._m_qdepth.set(sum(q.qsize() for q in self._queues.values()))
         n_missed = 0
         for i, r in enumerate(batch):
             queue_ms = (t0 - r.t_submit) * 1e3
@@ -252,6 +314,8 @@ class MicroBatcher:
             n_missed += bool(missed)
             self.queue_latency.add(queue_ms)
             self.latency.add(latency_ms)
+            m_queue.observe(queue_ms / 1e3)
+            m_latency.observe(latency_ms / 1e3)
             r.future.set_result(
                 PredictResult(
                     probs=probs[i],
@@ -264,6 +328,7 @@ class MicroBatcher:
         if n_missed:
             with self._lock:
                 self._counts["deadline_missed"] += n_missed
+            self._m_deadline.inc(n_missed)
         if self._metrics is not None:
             self._metrics.log(
                 "serve_batch",

@@ -261,3 +261,34 @@ class InferenceEngine:
         overlap = self.serve_config.tile_overlap
         ph, pw = max(h, tile), max(w, tile)
         return len(tile_plan(ph, tile, overlap)) * len(tile_plan(pw, tile, overlap))
+
+
+def watch_recompiles(engine: "InferenceEngine", registry: Any = None):
+    """Export the engine's jit-cache stability as the serve plane's
+    ``serve_recompiles_total`` gauge (a collect-time callback over a
+    :class:`~fedcrack_tpu.analysis.sanitizers.RecompileSentry`).
+
+    Call AFTER ``engine.warmup(...)``: the sentry marks the post-warmup
+    cache size as steady state, so every scrape reports recompiles SINCE
+    warmup — the steady-state/hot-swap contract says that number is 0, and
+    tests/test_serve.py pins it through a real ``/metrics`` scrape. On jax
+    builds without ``_cache_size`` the gauge reports -1 (unknown), never a
+    false 0. Returns the sentry for direct assertions."""
+    from fedcrack_tpu.analysis.sanitizers import RecompileSentry
+    from fedcrack_tpu.obs.registry import REGISTRY
+
+    sentry = RecompileSentry()
+    supported = RecompileSentry.supported(engine._fn)
+    if supported:
+        sentry.watch("serve.predict", engine._fn)
+        sentry.mark()
+    reg = registry if registry is not None else REGISTRY
+    reg.gauge(
+        "serve_recompiles_total",
+        "XLA recompiles of the serve predict program since warmup "
+        "(steady-state contract: 0 across any number of hot swaps; "
+        "-1 = this jax build exposes no jit cache size)",
+    ).set_function(
+        (lambda: sum(sentry.deltas().values())) if supported else (lambda: -1)
+    )
+    return sentry

@@ -33,6 +33,8 @@ from typing import Any
 import msgpack
 
 from fedcrack_tpu.analysis.sanitizers import make_lock
+from fedcrack_tpu.obs import spans as tracing
+from fedcrack_tpu.obs.registry import REGISTRY
 
 log = logging.getLogger("fedcrack.serve.hot_swap")
 
@@ -208,13 +210,34 @@ class ModelVersionManager:
         current_version = self.snapshot()[0]
         if version <= current_version:
             return False
-        t0 = time.monotonic()
-        device_variables = self.engine.prepare(host_variables)
-        load_ms = (time.monotonic() - t0) * 1e3
-        with self._lock:
-            if version <= self._current[0]:
-                return False  # raced with a concurrent poll
-            self._current = (version, device_variables)
+        with tracing.span(
+            "serve.swap",
+            trace=f"swap-v{version}",
+            from_version=current_version,
+            to_version=version,
+        ) as span_handle:
+            t0 = time.monotonic()
+            device_variables = self.engine.prepare(host_variables)
+            load_ms = (time.monotonic() - t0) * 1e3
+            with self._lock:
+                if version <= self._current[0]:
+                    # Raced with a concurrent poll: the span records the
+                    # wasted load attempt, flagged so span consumers can
+                    # count installed=true against serve_swaps_total.
+                    if span_handle is not None:
+                        span_handle.set(installed=False)
+                    return False
+                self._current = (version, device_variables)
+            if span_handle is not None:
+                span_handle.set(installed=True)
+        REGISTRY.counter(
+            "serve_swaps_total", "hot swaps installed by the version manager"
+        ).inc()
+        REGISTRY.histogram(
+            "serve_swap_pause_seconds",
+            "off-path load cost of a swap (decode + device placement; the "
+            "serving path pays only the pointer flip)",
+        ).observe(load_ms / 1e3)
         record = {
             "from_version": current_version,
             "to_version": version,

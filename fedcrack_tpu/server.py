@@ -136,6 +136,21 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         help="JSONL file for structured per-round metrics (SURVEY.md §5.5)",
     )
     p.add_argument(
+        "--metrics-port",
+        type=int,
+        dest="metrics_port",
+        default=0,
+        help="serve the live metric registry as Prometheus text format on "
+        "http://127.0.0.1:<port>/metrics (round 15 telemetry plane); "
+        "0 disables, -1 binds an ephemeral port (logged)",
+    )
+    p.add_argument(
+        "--spans-path",
+        dest="spans_path",
+        help="JSONL trace-span sink (fed.flush / client.push correlation "
+        "spans); empty disables span recording",
+    )
+    p.add_argument(
         "--tb-dir",
         dest="tb_dir",
         help="TensorBoard event-file directory: per-round metrics become "
@@ -315,10 +330,23 @@ def main(argv: list[str] | None = None) -> int:
         metrics = MetricsLogger(
             cfg.metrics_path or os.devnull, tb_dir=cfg.tb_dir or None
         )
+    exporter = None
+    if args.metrics_port:
+        from fedcrack_tpu.obs.promexp import start_exporter
+
+        exporter = start_exporter(args.metrics_port)
+        if exporter is not None:
+            logging.info("metrics: %s", exporter.url)
+    if args.spans_path:
+        from fedcrack_tpu.obs import spans as tracing
+
+        tracing.install(args.spans_path)
     server = FedServer(
         cfg, variables, checkpointer=checkpointer, metrics=metrics, eval_fn=eval_fn
     )
     final = asyncio.run(server.serve_until_finished())
+    if exporter is not None:
+        exporter.stop()
     for entry in server.eval_history:
         logging.info("server eval %s", entry)
     if metrics is not None:

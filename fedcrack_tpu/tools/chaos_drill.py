@@ -398,7 +398,15 @@ def run_straggler_storm_drill(
     would cap its throughput at K x versions while the stragglers are
     still sleeping — "sustained" is a rate, measured over a window). The
     returned artifact carries both arms plus the strict comparison bools
-    the acceptance gate reads."""
+    the acceptance gate reads.
+
+    Round 15: each arm's counts come from SCRAPING the live metric
+    registry over a real ``/metrics`` HTTP endpoint (before/after sample
+    deltas of ``fed_updates_total{result="accepted"}`` and
+    ``fed_global_versions_total``) — and each arm pins its scraped deltas
+    against the protocol history (``scrape_matches_history``), so the A/B
+    rates a dashboard would show and the rates this artifact reports are
+    the SAME numbers by construction, not parallel bookkeeping."""
     import threading
 
     from fedcrack_tpu.chaos.plan import (
@@ -407,8 +415,21 @@ def run_straggler_storm_drill(
         FaultPlan,
     )
     from fedcrack_tpu.fed.buffered import async_summary
+    from fedcrack_tpu.obs.promexp import MetricsExporter, sample_value, scrape
+    from fedcrack_tpu.obs.registry import REGISTRY
     from fedcrack_tpu.transport.codec import decode_scalar_map
     from fedcrack_tpu.transport.service import FedServer, ServerThread
+
+    def fed_counters(url: str) -> dict:
+        """One scrape, reduced to the two A/B series (absent -> 0: the
+        registry only materializes a family at its first bump)."""
+        parsed = scrape(url)
+        return {
+            "accepted": sample_value(
+                parsed, "fed_updates_total", {"result": "accepted"}
+            ) or 0.0,
+            "versions": sample_value(parsed, "fed_global_versions_total") or 0.0,
+        }
 
     names = [f"c{i}" for i in range(n_clients)]
     # One schedule, two arms: the delay dicts are read WITHOUT consuming
@@ -433,7 +454,7 @@ def run_straggler_storm_drill(
         if f.kind == STRAGGLER_DELAY
     }
 
-    def run_sync() -> dict:
+    def run_sync(url: str) -> dict:
         cfg = FedConfig(
             max_rounds=versions,
             cohort_size=n_clients,
@@ -469,6 +490,7 @@ def run_straggler_storm_drill(
             finally:
                 channel.close()
 
+        pre = fed_counters(url)
         t0 = time.perf_counter()
         with ServerThread(server) as server_thread:
             threads = [
@@ -480,17 +502,25 @@ def run_straggler_storm_drill(
                 t.join(timeout=60)
             wall = time.perf_counter() - t0
             state = server_thread.state
-        accepted = sum(len(h["clients"]) for h in state.history)
+        post = fed_counters(url)
+        # The arm's counts come from the SCRAPE (before/after deltas of the
+        # live registry over HTTP); the protocol history is the cross-check.
+        n_accepted = int(post["accepted"] - pre["accepted"])
+        n_versions = int(post["versions"] - pre["versions"])
         return {
             "wall_s": round(wall, 4),
-            "accepted_updates": int(accepted),
-            "global_versions": int(state.model_version),
-            "updates_per_sec": round(accepted / wall, 3),
-            "versions_per_min": round(state.model_version / wall * 60.0, 3),
+            "accepted_updates": n_accepted,
+            "global_versions": n_versions,
+            "updates_per_sec": round(n_accepted / wall, 3),
+            "versions_per_min": round(n_versions / wall * 60.0, 3),
+            "scrape_matches_history": (
+                n_accepted == sum(len(h["clients"]) for h in state.history)
+                and n_versions == int(state.model_version)
+            ),
             "errors": errors,
         }
 
-    def run_buffered(window_s: float) -> dict:
+    def run_buffered(window_s: float, url: str) -> dict:
         cfg = FedConfig(
             # A horizon the window can never reach: the drill measures the
             # SUSTAINED rate over `window_s`, not time-to-N-versions.
@@ -530,6 +560,7 @@ def run_straggler_storm_drill(
             finally:
                 channel.close()
 
+        pre = fed_counters(url)
         t0 = time.perf_counter()
         with ServerThread(server) as server_thread:
             threads = [
@@ -538,28 +569,43 @@ def run_straggler_storm_drill(
             for t in threads:
                 t.start()
             time.sleep(window_s)
-            # Snapshot AT the window edge: in-flight sleeps past it must
-            # not count (the rates divide by window_s).
-            state = server_thread.state
+            # Measure AT the window edge: in-flight sleeps past it must not
+            # count (the rates divide by window_s). Scrape-sandwich the
+            # state snapshot — two identical scrapes bracketing the read
+            # prove no update landed mid-measurement, so the scraped deltas
+            # and the history describe the SAME instant.
+            for _ in range(200):
+                post = fed_counters(url)
+                state = server_thread.state
+                if fed_counters(url) == post:
+                    break
             stop.set()
             for t in threads:
                 t.join(timeout=60)
         summary = async_summary(state.history)
-        accepted = int(summary["accepted_updates"]) + len(state.buffer)
+        n_accepted = int(post["accepted"] - pre["accepted"])
+        n_versions = int(post["versions"] - pre["versions"])
         return {
             "wall_s": round(window_s, 4),
-            "accepted_updates": accepted,
-            "global_versions": int(state.model_version),
-            "updates_per_sec": round(accepted / window_s, 3),
-            "versions_per_min": round(state.model_version / window_s * 60.0, 3),
+            "accepted_updates": n_accepted,
+            "global_versions": n_versions,
+            "updates_per_sec": round(n_accepted / window_s, 3),
+            "versions_per_min": round(n_versions / window_s * 60.0, 3),
+            "scrape_matches_history": (
+                n_accepted
+                == int(summary["accepted_updates"]) + len(state.buffer)
+                and n_versions == int(state.model_version)
+            ),
             "staleness": summary["staleness"],
             "mean_buffer_fill": summary["mean_buffer_fill"],
             "errors": errors,
         }
 
-    sync = run_sync()
-    buffered = run_buffered(sync["wall_s"])
+    with MetricsExporter(REGISTRY) as exporter:
+        sync = run_sync(exporter.url)
+        buffered = run_buffered(sync["wall_s"], exporter.url)
     return {
+        "rates_scraped_from_registry": True,
         "seed": seed,
         "n_clients": n_clients,
         "versions": versions,

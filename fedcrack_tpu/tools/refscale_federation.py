@@ -561,9 +561,42 @@ def main(argv=None) -> int:
         help="resume from the latest checkpoint under --ckpt-dir at round "
         "r+1 with an identical trajectory (deterministic data path)",
     )
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=0,
+        help="Prometheus /metrics endpoint over the live registry for the "
+        "session (driver round wall, staged bytes, leak-sentry "
+        "watermarks); 0 disables, -1 binds an ephemeral port",
+    )
+    p.add_argument(
+        "--spans-path",
+        default="",
+        help="JSONL trace-span sink (driver.round correlation spans); "
+        "empty disables",
+    )
     args = p.parse_args(argv)
 
+    exporter = None
+    if args.metrics_port:
+        from fedcrack_tpu.obs.promexp import start_exporter
+        from fedcrack_tpu.obs.sentries import LeakSentry
+
+        exporter = start_exporter(args.metrics_port)
+        if exporter is not None:
+            print(f"metrics: {exporter.url}", flush=True)
+            # sample_on_collect: this session has no sampling loop, so each
+            # scrape refreshes the reading — a frozen startup RSS would
+            # hide any leak the session develops.
+            LeakSentry(sample_on_collect=True).mark()
+    if args.spans_path:
+        from fedcrack_tpu.obs import spans as tracing
+
+        tracing.install(args.spans_path)
+
     artifact = run_refscale_federation(args)
+    if exporter is not None:
+        exporter.stop()
     os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=1, sort_keys=True)

@@ -29,6 +29,8 @@ from typing import Any, Callable, Sequence
 import grpc
 
 from fedcrack_tpu.compress import get_codec
+from fedcrack_tpu.obs import spans as tracing
+from fedcrack_tpu.obs.registry import REGISTRY
 from fedcrack_tpu.configs import FedConfig
 from fedcrack_tpu.fed import rounds as R
 from fedcrack_tpu.native import crc32c
@@ -208,12 +210,38 @@ class FedClient:
                 ):
                     raise
                 log.warning("rpc failed (%s); retrying in %.1fs", code, sleep_s)
+                REGISTRY.counter(
+                    "client_retries_total",
+                    "transient-RPC retries spent by the transport client "
+                    "(non-retryable codes surface immediately, uncounted)",
+                ).inc()
                 time.sleep(sleep_s)
                 delay = min(delay * 2, 5.0)
         raise AssertionError("unreachable")
 
     def _msg(self) -> pb.ClientMessage:
         return pb.ClientMessage(cname=self.cname, token=self.config.auth_token)
+
+    def _count_wire(self, direction: str, n_bytes: int, codec: str | None = None) -> None:
+        """Transport-plane byte accounting: uploads are labeled with the
+        negotiated codec (the r12 compression win is visible per codec),
+        broadcasts/pulls with 'raw'."""
+        if n_bytes:
+            REGISTRY.counter(
+                "client_wire_bytes_total",
+                "weight bytes moved by the transport client, by direction "
+                "and codec",
+                labels=("direction", "codec"),
+            ).labels(
+                direction=direction, codec=codec or "raw"
+            ).inc(n_bytes)
+
+    def _count_resync(self) -> None:
+        REGISTRY.counter(
+            "client_resyncs_total",
+            "NOT_WAIT resyncs absorbed (upload never averaged; codec "
+            "cross-round state rolled back)",
+        ).inc()
 
     # -- the session --
 
@@ -267,7 +295,11 @@ class FedClient:
             # Phase 2: pull global weights (reference 'P', fl_client.py:99-102)
             msg = self._msg()
             msg.pull.SetInParent()
-            weights = self._call(method, msg).weights
+            with tracing.span(
+                "client.pull", trace=f"round-{current_round}", cname=self.cname
+            ):
+                weights = self._call(method, msg).weights
+            self._count_wire("down", len(weights))
 
             while True:
                 # Phase 3: announce training (reference 'T', fl_client.py:106-107)
@@ -314,7 +346,15 @@ class FedClient:
                     msg.done.metrics,
                     {k: float(v) for k, v in metrics.items()},
                 )
-                rep = self._call(method, msg)
+                self._count_wire("up", len(upload), self.codec.name)
+                with tracing.span(
+                    "client.push",
+                    trace=f"round-{current_round}",
+                    cname=self.cname,
+                    upload_bytes=len(upload),
+                    codec=self.codec.name,
+                ):
+                    rep = self._call(method, msg)
 
                 if rep.status == R.NOT_WAIT:
                     # Straggler past quorum: a NOT_WAIT on the TrainDone
@@ -330,6 +370,7 @@ class FedClient:
                     # (rolling back aggregated mass would re-transmit it
                     # next round: applied twice, not 'only delayed').
                     self.codec.rollback_last()
+                    self._count_resync()
                 if rep.status == R.RESP_ACY:
                     rep = self._poll(method, model_version, current_round)
                 if rep.status == R.REJECTED:
@@ -363,8 +404,10 @@ class FedClient:
         while True:
             msg = self._msg()
             msg.pull.SetInParent()
-            rep = self._call(method, msg)
+            with tracing.span("client.pull", trace="buffered", cname=self.cname):
+                rep = self._call(method, msg)
             weights = rep.weights
+            self._count_wire("down", len(weights))
             pcfg = decode_scalar_map(rep.config)
             base_version = int(pcfg.get("model_version", 0))
             current_round = int(pcfg.get("current_round", 1))
@@ -395,7 +438,15 @@ class FedClient:
             encode_scalar_map(
                 msg.done.metrics, {k: float(v) for k, v in metrics.items()}
             )
-            rep = self._call(method, msg)
+            self._count_wire("up", len(upload), self.codec.name)
+            with tracing.span(
+                "client.push",
+                trace=f"round-{current_round}",
+                cname=self.cname,
+                upload_bytes=len(upload),
+                codec=self.codec.name,
+            ):
+                rep = self._call(method, msg)
             result.history.append(
                 {
                     "round": current_round,
@@ -410,6 +461,7 @@ class FedClient:
                 # and will never be averaged — give the codec its
                 # cross-round mass back (see the sync-path comment above).
                 self.codec.rollback_last()
+                self._count_resync()
             elif rep.status == R.REJECTED:
                 raise RuntimeError(
                     f"server rejected update: {decode_scalar_map(rep.config)}"

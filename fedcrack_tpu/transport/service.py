@@ -30,10 +30,117 @@ import grpc
 
 from fedcrack_tpu.configs import FedConfig
 from fedcrack_tpu.fed import rounds as R
+from fedcrack_tpu.obs import spans as tracing
+from fedcrack_tpu.obs.registry import DEFAULT_VERSIONS_BUCKETS, REGISTRY
 from fedcrack_tpu.transport import transport_pb2 as pb
 from fedcrack_tpu.transport.codec import event_from_message, message_from_reply
 
 log = logging.getLogger("fedcrack.server")
+
+
+def _reason_class(reason: str) -> str:
+    """Collapse a free-form rejection message into a stable label value —
+    label cardinality must stay bounded (a per-message label would mint one
+    time series per distinct error string)."""
+    r = reason.lower()
+    if "not in cohort" in r:
+        return "not_in_cohort"
+    if "stale" in r:  # "too stale: ...", "stale round", un-retained base
+        return "stale"
+    if "rejected" in r or "frame" in r:
+        return "sanitation"
+    return "other"
+
+
+def observe_transition(
+    prev: R.ServerState,
+    state: R.ServerState,
+    event: R.Event,
+    reply: R.Reply,
+    wall_s: float,
+) -> None:
+    """Diff ONE state transition into the process metric registry — the
+    fed-plane instrumentation point. The round machines (``fed/rounds``,
+    ``fed/buffered``) stay pure functions; the single-writer ``_apply``
+    already sees every (prev, next) pair, so the metrics are a projection
+    of the same transitions the statefile and history record — they cannot
+    drift from protocol truth. Counter bumps are dict ops + a leaf lock
+    (microseconds); nothing here touches the reply path's latency budget.
+    """
+    if isinstance(event, R.TrainDone):
+        updates = REGISTRY.counter(
+            "fed_updates_total",
+            "client updates by outcome: accepted into the round/buffer, "
+            "resynced (NOT_WAIT, never averaged), or rejected by reason",
+            labels=("result",),
+        )
+        REGISTRY.counter(
+            "fed_wire_bytes_total",
+            "weight bytes crossing the control plane (up = client uploads, "
+            "down = broadcast pulls)",
+            labels=("direction",),
+        ).labels(direction="up").inc(len(event.blob))
+        if reply.status in (R.RESP_ACY, R.RESP_ARY) or (
+            # The upload that closes the FINAL round is aggregated and
+            # answered FIN directly (a late upload after FIN carries no
+            # version bump and stays uncounted — it was never averaged).
+            reply.status == R.FIN
+            and state.model_version != prev.model_version
+        ):
+            updates.labels(result="accepted").inc()
+        elif reply.status == R.NOT_WAIT:
+            updates.labels(result="resync").inc()
+            REGISTRY.counter(
+                "fed_resyncs_total",
+                "NOT_WAIT resyncs: uploads refused past quorum close or "
+                "past max_staleness, sender handed the current global",
+            ).inc()
+        elif reply.status == R.REJECTED:
+            reason = _reason_class(str(reply.config.get("reason", "")))
+            updates.labels(result=f"rejected_{reason}").inc()
+    elif isinstance(event, R.PullWeights) and reply.blob:
+        REGISTRY.counter(
+            "fed_wire_bytes_total",
+            "weight bytes crossing the control plane (up = client uploads, "
+            "down = broadcast pulls)",
+            labels=("direction",),
+        ).labels(direction="down").inc(len(reply.blob))
+    REGISTRY.gauge(
+        "fed_buffer_fill_total",
+        "accepted-but-unflushed updates in the FedBuff buffer (0 in sync "
+        "mode)",
+    ).set(len(state.buffer))
+    if state.config.mode == "buffered" and state.config.buffer_k > 0:
+        REGISTRY.gauge(
+            "fed_buffer_fill_ratio",
+            "buffer fill as a fraction of buffer_k (1.0 = flush imminent)",
+        ).set(len(state.buffer) / state.config.buffer_k)
+    if state.model_version != prev.model_version:
+        REGISTRY.counter(
+            "fed_global_versions_total",
+            "global model version publishes (sync aggregations + buffered "
+            "flushes)",
+        ).inc(state.model_version - prev.model_version)
+        REGISTRY.counter(
+            "fed_rounds_total",
+            "completed aggregations (one history entry each)",
+        ).inc()
+        REGISTRY.histogram(
+            "fed_flush_seconds",
+            "wall clock of the version-publishing transition (the sorted "
+            "fold + FedOpt step + re-serialization)",
+        ).observe(wall_s)
+        entry = state.history[-1] if state.history else {}
+        staleness = entry.get("staleness")
+        if isinstance(staleness, (list, tuple)):
+            hist = REGISTRY.histogram(
+                "fed_update_staleness_versions",
+                "staleness (model versions behind the global) of each "
+                "update at the flush that averaged it",
+                buckets=DEFAULT_VERSIONS_BUCKETS,
+            )
+            for s in staleness:
+                hist.observe(float(s))
 
 SERVICE_NAME = "fedcrack.FedControl"
 METHOD = "Session"
@@ -287,14 +394,31 @@ class FedServer:
 
     async def _apply(self, event: R.Event) -> R.Reply:
         async with self._lock:
+            prev_state = self.state
             prev_version = self.state.model_version
             prev_sig = (
                 self._persist_sig(self.state) if self._state_path else None
             )
+            t_apply = time.perf_counter()
             self.state, reply = R.transition(self.state, event)
+            apply_s = time.perf_counter() - t_apply
             if self.state.phase == R.PHASE_FINISHED:
                 self.finished.set()
             state = self.state
+        try:
+            observe_transition(prev_state, state, event, reply, apply_s)
+        except Exception:  # telemetry must never break the protocol
+            log.exception("metric observation failed; protocol unaffected")
+        if state.model_version != prev_version:
+            # Zero-duration correlation marker: the flush/aggregation span
+            # for trace `round-N` (the transition itself was timed above).
+            with tracing.span(
+                "fed.flush",
+                trace=f"round-{prev_state.current_round}",
+                version=state.model_version,
+                apply_s=round(apply_s, 6),
+            ):
+                pass
         if self._state_path and self._persist_sig(state) != prev_sig:
             # Durable mid-round state: persisted off the serving path like
             # the checkpoint — a stalled disk must not freeze the protocol,

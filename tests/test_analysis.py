@@ -305,6 +305,43 @@ def test_async001_unsorted_iteration_in_flush_path():
     assert "ASYNC001" not in rule_ids(lint(bad, path="fedcrack_tpu/serve/fx.py"))
 
 
+# ---- observability pack ----
+
+
+def test_obs001_metric_name_literal_with_unit_suffix():
+    """OBS001: registry metric names must be snake_case string literals
+    with a unit suffix — computed or free-spelled names break the greppable
+    catalog and can mint unbounded series."""
+    good = (
+        "from fedcrack_tpu.obs.registry import REGISTRY\n"
+        "REGISTRY.counter('fed_updates_total', 'updates').inc()\n"
+        "REGISTRY.histogram('serve_request_seconds', 'latency')\n"
+        "REGISTRY.gauge('fed_buffer_fill_ratio', 'fill')\n"
+    )
+    assert "OBS001" not in rule_ids(lint(good))
+    # Computed name: ungreppable, potentially unbounded.
+    computed = (
+        "from fedcrack_tpu.obs.registry import REGISTRY\n"
+        "REGISTRY.counter(f'updates_{plane}_total', 'per-plane').inc()\n"
+    )
+    assert "OBS001" in rule_ids(lint(computed))
+    # Free spelling: no unit suffix / not snake_case.
+    assert "OBS001" in rule_ids(
+        lint("registry.counter('updates_count', 'x')\n")
+    )
+    assert "OBS001" in rule_ids(lint("registry.gauge('FedUpdates_total', 'x')\n"))
+    # name= keyword path is checked the same way.
+    assert "OBS001" in rule_ids(
+        lint("reg.histogram(name=make_name(), help='x')\n")
+    )
+    assert "OBS001" not in rule_ids(
+        lint("reg.histogram(name='fed_flush_seconds', help='x')\n")
+    )
+    # Non-registry receivers with the same method names are not ours.
+    assert "OBS001" not in rule_ids(lint("collections.Counter('abc')\n"))
+    assert "OBS001" not in rule_ids(lint("stats.counter('whatever')\n"))
+
+
 # ---- lock-order pack (project scope: lint_modules, not lint_source) ----
 
 CYCLE_SRC = """\

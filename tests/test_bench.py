@@ -336,6 +336,45 @@ def test_validate_detail_typed_checks():
     )
     assert any("update_compression.wire['int8']" in v
                for v in bench.validate_detail(bad6))
+    # Round-15 observability arm: error-arm exempt; a present arm must carry
+    # the soak contract (audit booleans typed, planes_covered a dict).
+    assert bench.validate_detail({"observability": {"error": "boom"}}) == []
+    assert any(
+        "observability" in v
+        for v in bench.validate_detail({"observability": {"audit": {}}})
+    )
+    obs_ok = {
+        "observability": {
+            "traffic_wall_s": 8.0,
+            "storm_fired": True,
+            "federation": {},
+            "serve": {},
+            "scrape": {"planes_covered": {"fed": True}},
+            "spans": {},
+            "audit": {
+                "torn_versions": 0,
+                "zero_torn_versions": True,
+                "serve_healthy": True,
+                "ef_mass_conserved": True,
+                "statefile_restore_bit_identical": True,
+                "watermarks_steady": True,
+                "recompiles_since_warmup": 0,
+                "clean": True,
+            },
+        }
+    }
+    assert bench.validate_detail(obs_ok) == []
+    obs_bad = json.loads(json.dumps(obs_ok))
+    obs_bad["observability"]["audit"]["torn_versions"] = "none"
+    assert any(
+        "observability.audit['torn_versions']" in v
+        for v in bench.validate_detail(obs_bad)
+    )
+    obs_bad2 = json.loads(json.dumps(obs_ok))
+    obs_bad2["observability"]["scrape"]["planes_covered"] = ["fed"]
+    assert any(
+        "planes_covered" in v for v in bench.validate_detail(obs_bad2)
+    )
 
 
 def test_compact_summary_last_line_parses():

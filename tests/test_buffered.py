@@ -464,6 +464,24 @@ def test_buffered_kill_restart_drill():
     assert out["global_version_identical"]
 
 
+@pytest.mark.chaos
+def test_storm_drill_rates_come_from_registry_scrape():
+    """Round-15 satellite: the sync-vs-buffered A/B rates are before/after
+    deltas of a REAL /metrics scrape, and each arm pins its scraped counts
+    against the protocol history — the drill artifact and a dashboard
+    watching the same registry can never disagree."""
+    from fedcrack_tpu.tools.chaos_drill import run_straggler_storm_drill
+
+    out = run_straggler_storm_drill(seed=0, versions=2)
+    assert out["rates_scraped_from_registry"]
+    assert out["storm_fired"]
+    for arm in ("sync", "buffered"):
+        assert out[arm]["scrape_matches_history"], out[arm]
+        assert out[arm]["errors"] == []
+        assert out[arm]["accepted_updates"] > 0
+    assert out["buffered_gt_sync_updates_per_sec"]
+
+
 # ---------- staleness-aware error feedback ----------
 
 def test_ef_decay_preserves_default_and_scales_residual():

@@ -257,6 +257,37 @@ def test_hot_swap_post_swap_bit_identical_to_cold_start(stack):
     assert mgr.last_swap["to_version"] == 1 and mgr.last_swap["load_ms"] >= 0
 
 
+def test_recompiles_stay_zero_across_hot_swap_via_metrics_scrape(stack):
+    """Round-15 satellite: the serve plane's jit-cache stability is pinned
+    through a REAL ``/metrics`` scrape, not just the in-object counter —
+    `serve_recompiles_total` must read 0 over HTTP after traffic on both
+    sides of a hot swap (a swap installs new weights, never a new program)."""
+    from fedcrack_tpu.obs.promexp import MetricsExporter, sample_value, scrape
+    from fedcrack_tpu.obs.registry import MetricsRegistry
+    from fedcrack_tpu.serve import MicroBatcher, ModelVersionManager
+    from fedcrack_tpu.serve.engine import watch_recompiles
+
+    engine, var0, var1 = stack
+    imgs = _images(4, 16, seed=21)
+    mgr = ModelVersionManager(engine, var0)
+    # Warm the bucket program BEFORE the sentry marks steady state (the
+    # module fixture usually did already; this makes the test order-proof).
+    engine.predict_bucket(engine.prepare(var0), imgs)
+    reg = MetricsRegistry()
+    sentry = watch_recompiles(engine, registry=reg)
+    if not sentry.deltas() and not type(sentry).supported(engine._fn):
+        pytest.skip("this jax build exposes no jit cache size")
+    with MetricsExporter(reg) as exporter:
+        with MicroBatcher(engine, mgr, max_delay_ms=200.0) as b:
+            [f.result(timeout=60) for f in [b.submit(i) for i in imgs]]
+            assert mgr.install(1, var1)
+            [f.result(timeout=60) for f in [b.submit(i) for i in imgs]]
+        mgr.stop()
+        parsed = scrape(exporter.url)
+    assert sample_value(parsed, "serve_recompiles_total") == 0
+    sentry.assert_steady()
+
+
 def test_swap_mid_batch_no_torn_reads(stack):
     """A batch straddling a swap gets EXACTLY one version's outputs: the
     chaos hook installs v1 after the worker snapshotted v0, and the whole
