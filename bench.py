@@ -195,6 +195,40 @@ OBSERVABILITY_AUDIT_SCHEMA: dict = {
     "recompiles_since_warmup": int,
     "clean": bool,
 }
+# Additive round-16 arms of detail.observability — distributed tracing and
+# the SLO watchdog. Typed (and sub-schema'd) whenever PRESENT; presence
+# itself is required only from round 16 on (the committed r15 artifact
+# predates them — the dedicated r16 artifact test pins presence AND the
+# ≥3-planes single-trace chain).
+OBSERVABILITY_R16_SCHEMA: dict = {
+    "tracing": dict,
+    "watchdog": dict,
+}
+# Required keys of detail.observability.tracing: the stitched-trace summary
+# (tools/trace_stitch.py over the soak's span JSONL) — `complete` means one
+# trace id followed client train → push → flush → swap → first served
+# batch, `planes_crossed` lists the span-name planes on that chain.
+OBSERVABILITY_TRACING_SCHEMA: dict = {
+    "records": int,
+    "traces": int,
+    "chains": int,
+    "n_complete": int,
+    "complete": bool,
+    "trace": (str, type(None)),
+    "planes_crossed": list,
+    "stages": list,
+}
+# Required keys of detail.observability.watchdog: the machine-checked SLO
+# audit (obs/watchdog.py) — every rule evaluated, zero breaches = clean.
+OBSERVABILITY_WATCHDOG_SCHEMA: dict = {
+    "rules_evaluated": int,
+    "rules": list,
+    "evaluations": int,
+    "never_determinate": list,
+    "all_rules_evaluated": bool,
+    "breaches": list,
+    "clean": bool,
+}
 # Typed keys of detail.async_federation (round 14): the buffered-async
 # contract — the chaos straggler-storm sync-vs-buffered A/B at equal wall,
 # the bit-exact sync-degeneration pin, the mid-buffer kill→restart drill,
@@ -372,6 +406,25 @@ def validate_detail(detail: dict) -> list:
                     f"observability.scrape['planes_covered']: "
                     f"{type(planes).__name__}"
                 )
+        for key, typs in OBSERVABILITY_R16_SCHEMA.items():
+            if key not in obsy:
+                continue  # additive from round 16; r15 artifacts predate it
+            if not isinstance(obsy[key], typs):
+                bad.append(f"observability[{key!r}]: {type(obsy[key]).__name__}")
+                continue
+            sub_schema = (
+                OBSERVABILITY_TRACING_SCHEMA
+                if key == "tracing"
+                else OBSERVABILITY_WATCHDOG_SCHEMA
+            )
+            for sub, styps in sub_schema.items():
+                if sub not in obsy[key]:
+                    bad.append(f"observability.{key}[{sub!r}] missing")
+                elif not isinstance(obsy[key][sub], styps):
+                    bad.append(
+                        f"observability.{key}[{sub!r}]: "
+                        f"{type(obsy[key][sub]).__name__}"
+                    )
     cohort = detail.get("cohort_scale")
     if isinstance(cohort, dict) and "error" not in cohort:
         for key, typs in COHORT_SCALE_SCHEMA.items():

@@ -21,6 +21,15 @@
   conventional short alias ``reg`` — so the rule follows the idiom, not
   the import graph. Calls that pass the name via ``name=`` keyword are
   checked the same way.
+
+- **OBS002 non-catalog span name** (round 16): every ``tracing.span(...)``
+  call site must name its span with a **string literal** that is a dotted
+  ``plane.verb`` (``client.push``, ``fed.flush``, ``edge.flush_partial``)
+  — the OBS001 literal-name contract extended to spans. The plane prefix
+  is what ``tools/trace_stitch.py`` reports as ``planes_crossed`` and what
+  the soak's span census groups by; a computed or undotted name breaks
+  both. The receiver is matched by idiom: the module alias ``tracing``
+  (the repo convention) or ``spans``.
 """
 
 from __future__ import annotations
@@ -100,4 +109,55 @@ class MetricCatalogNameRule(Rule):
                 )
 
 
-RULES = (MetricCatalogNameRule,)
+SPAN_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
+
+
+def _tracing_receiver(call: ast.Call) -> bool:
+    func = call.func
+    if not isinstance(func, ast.Attribute) or func.attr != "span":
+        return False
+    recv = func.value
+    if isinstance(recv, ast.Name):
+        name = recv.id
+    elif isinstance(recv, ast.Attribute):
+        name = recv.attr
+    else:
+        return False
+    low = name.lower()
+    return low in ("tracing", "spans") or "tracing" in low
+
+
+class SpanCatalogNameRule(Rule):
+    id = "OBS002"
+    severity = Severity.ERROR
+    description = (
+        "tracing.span(...) span name must be a dotted plane.verb string "
+        "literal (e.g. 'client.push', 'fed.flush') — computed or undotted "
+        "names break the stitchable span catalog and the plane census"
+    )
+
+    def check(self, module: ModuleSource) -> Iterable[Finding]:
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call) and _tracing_receiver(node)):
+                continue
+            arg = _name_arg(node)
+            if arg is None:
+                yield self.finding(module, node, "span call without a name argument")
+                continue
+            if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+                yield self.finding(
+                    module,
+                    arg if hasattr(arg, "lineno") else node,
+                    "span name must be a string LITERAL (computed names "
+                    "make the span catalog ungreppable)",
+                )
+                continue
+            if not SPAN_NAME_RE.match(arg.value):
+                yield self.finding(
+                    module, arg,
+                    f"span name {arg.value!r} is not a dotted plane.verb "
+                    "([a-z][a-z0-9_]* '.' [a-z][a-z0-9_]*)",
+                )
+
+
+RULES = (MetricCatalogNameRule, SpanCatalogNameRule)

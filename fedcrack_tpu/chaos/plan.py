@@ -148,6 +148,14 @@ class FaultPlan:
                 continue
             del self.pending[i]
             self.triggered.append(f)
+            # Flight-recorder feed (round 16): every fault actually handed
+            # out lands in the bounded ring, so a red run's dump shows the
+            # injections that preceded it (one global read when no ring).
+            from fedcrack_tpu.obs import flight
+
+            flight.note(
+                "chaos.fault", fault=f.kind, client=f.client, round=f.round
+            )
             return f
         return None
 

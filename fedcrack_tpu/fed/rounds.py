@@ -143,12 +143,18 @@ class LogChunk:
 
 @dataclass(frozen=True)
 class TrainDone:
-    """Local weights for `round` (reference 'D', fl_server.py:176-196)."""
+    """Local weights for `round` (reference 'D', fl_server.py:176-196).
+
+    ``trace_ctx`` is the sender's wire-safe span context (round 16,
+    ``obs.spans.TraceContext`` — carried in-band like the codec handshake).
+    Pure observability: the transition function never reads it; the
+    transport layer re-parents it onto the flush span."""
     cname: str
     round: int
     blob: bytes
     num_samples: int
     now: float
+    trace_ctx: str = ""
 
 
 @dataclass(frozen=True)

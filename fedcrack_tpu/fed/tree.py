@@ -47,6 +47,7 @@ from fedcrack_tpu.fed.algorithms import fedavg, sample_cohort
 from fedcrack_tpu.fed.rounds import decode_and_validate_update, quorum_target
 from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
 from fedcrack_tpu.ioutils import atomic_write_bytes
+from fedcrack_tpu.obs import spans as tracing
 from fedcrack_tpu.obs.registry import REGISTRY
 
 log = logging.getLogger("fedcrack.fed.tree")
@@ -177,6 +178,13 @@ class EdgeAggregator:
         # state — a per-round codec would silently drop each round's
         # unsent partial-delta mass forever instead of re-entering it.
         self._codec = None
+        # Trace re-parenting (round 16): the wire context each accepted
+        # leaf offer carried, linked onto the edge's flush span; the
+        # flush's OWN context rides the hop up so the root re-parents the
+        # edge exactly like a client. Observability only — never persisted.
+        self.trace_links: dict[str, str] = {}
+        self.last_partial_ctx: str = ""
+        self._flush_seq = 0
 
     # -- round lifecycle --
 
@@ -253,7 +261,44 @@ class EdgeAggregator:
     def quorum_met(self) -> bool:
         return len(self.received) >= self.quorum
 
-    def offer(self, cname: str, blob: bytes, num_samples: int) -> tuple[bool, str | None]:
+    def _stamp_trace(self, cname: str, trace_ctx: str) -> None:
+        """Remember an accepted offer's wire context for the flush span's
+        links; anything unparseable degrades to no link, never an error."""
+        if trace_ctx and tracing.TraceContext.from_wire(trace_ctx) is not None:
+            self.trace_links[cname] = trace_ctx
+
+    def _emit_flush_span(self, cnames: list[str]) -> str:
+        """Re-parent the flushed leaves' contexts onto one
+        ``edge.flush_partial`` span and mint this flush's OWN wire context
+        (returned, and kept as ``last_partial_ctx``) for the hop up — the
+        root then links the edge exactly like a client. Shared by the
+        buffered and sync flush paths so the re-parenting idiom cannot
+        drift between them."""
+        self._flush_seq += 1
+        ectx = tracing.TraceContext(
+            tracing.version_trace(self.base_version),
+            f"edge:{self.edge_id}:flush:{self._flush_seq}",
+        )
+        links = []
+        for name in cnames:
+            wire = self.trace_links.pop(name, None)
+            if wire is not None:
+                links.append(wire)
+        with tracing.span(
+            "edge.flush_partial",
+            trace=ectx.trace,
+            ctx=ectx.to_wire(),
+            links=sorted(links),
+            edge=self.edge_id,
+            buffer_fill=len(cnames),
+        ):
+            pass
+        self.last_partial_ctx = ectx.to_wire()
+        return self.last_partial_ctx
+
+    def offer(
+        self, cname: str, blob: bytes, num_samples: int, trace_ctx: str = ""
+    ) -> tuple[bool, str | None]:
         """One leaf's upload. Routes through the SAME
         ``decode_and_validate_update`` gate the root runs — a corrupt
         frame, wrong-shape tree or NaN update is rejected (recorded, never
@@ -281,12 +326,18 @@ class EdgeAggregator:
         _edge_updates_counter().labels(result="accepted").inc()
         self.received[cname] = (decoded, int(num_samples))
         self.wire_bytes[cname] = wire_len
+        self._stamp_trace(cname, trace_ctx)
         self.peak_resident_blobs = max(self.peak_resident_blobs, len(self.received))
         self._persist()
         return True, None
 
     def offer_buffered(
-        self, cname: str, blob: bytes, num_samples: int, base_version: int
+        self,
+        cname: str,
+        blob: bytes,
+        num_samples: int,
+        base_version: int,
+        trace_ctx: str = "",
     ) -> tuple[bool, str | None]:
         """Buffered mode's leaf upload: gated by the SAME
         ``decode_and_validate_update`` — against the base the leaf
@@ -327,6 +378,7 @@ class EdgeAggregator:
         if problem is not None:
             return self._refuse(cname, problem)
         _edge_updates_counter().labels(result="accepted").inc()
+        self._stamp_trace(cname, trace_ctx)
         self.buffer.append(
             {
                 "cname": cname,
@@ -406,12 +458,17 @@ class EdgeAggregator:
         REGISTRY.counter(
             "edge_flushes_total", "edge-tier partial aggregations pushed up"
         ).inc()
+        # Re-parent the flushed leaves' contexts onto this flush span; its
+        # OWN context rides the hop up (info["trace_ctx"] → the relay's
+        # "__trace") so the root links the edge like any client.
+        flush_ctx = self._emit_flush_span([e["cname"] for e in entries])
         info = {
             "clients": [e["cname"] for e in entries],
             "staleness": [e["staleness"] for e in entries],
             "weights": [e["weight"] for e in entries],
             "buffer_fill": len(entries),
             "effective_samples": total_eff,
+            "trace_ctx": flush_ctx,
         }
         self.buffer = []
         self._persist()
@@ -459,6 +516,7 @@ class EdgeAggregator:
         REGISTRY.counter(
             "edge_flushes_total", "edge-tier partial aggregations pushed up"
         ).inc()
+        self._emit_flush_span(list(names))
         return blob, total
 
     def end_round(self) -> None:

@@ -11,6 +11,14 @@ Round 15 adds the live telemetry plane: a thread-safe metric registry with
 one catalog across all planes (``registry``), Prometheus text-format
 exposition over HTTP (``promexp``), correlated trace spans (``spans``) and
 RSS/device-memory leak sentries (``sentries``).
+
+Round 16 makes it an ops plane that notices: cross-process distributed
+tracing (wire-safe ``TraceContext`` + version-lineage trace ids in
+``spans``, stitched by ``tools/trace_stitch``), a crash flight recorder
+(``flight`` — a bounded ring every plane feeds for free, dumped on
+exceptions/SIGUSR2/failed audits), and the SLO watchdog (``watchdog`` —
+declarative thresholds over the registry with a breach → flight-dump →
+exit-code contract).
 """
 
 from fedcrack_tpu.obs.flops import (

@@ -3,8 +3,9 @@
 ``MetricsExporter(registry)`` binds a ``ThreadingHTTPServer`` (port 0 =
 ephemeral, like every other harness-facing port in the repo), serves the
 registry's text-format v0.0.4 exposition at ``GET /metrics`` (anything else
-is 404, ``/healthz`` answers ``ok`` for liveness probes), and shuts down
-cleanly. No third-party client library: the text format is ~20 lines to
+is 404; ``/healthz`` answers a small JSON liveness body — registry family
+count, uptime, spans-installed flag, git describe when available — so a
+load balancer can tell "up" from "warm"), and shuts down cleanly. No third-party client library: the text format is ~20 lines to
 write deterministically (``registry.exposition()``) and ~40 to parse back
 (:func:`parse_prometheus_text`), and the stdlib server is one daemon thread
 — the same footprint discipline as the hand-bound gRPC service.
@@ -17,9 +18,12 @@ hand-counting, and the soak audits itself through its own endpoint.
 
 from __future__ import annotations
 
+import json
 import logging
 import math
+import os
 import threading
+import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
@@ -29,6 +33,32 @@ from fedcrack_tpu.obs.registry import REGISTRY, MetricsRegistry
 log = logging.getLogger("fedcrack.obs.promexp")
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+HEALTH_CONTENT_TYPE = "application/json; charset=utf-8"
+
+_GIT_DESCRIBE: list[str | None] = []  # lazy one-shot cache ([] = not asked yet)
+
+
+def git_describe() -> str | None:
+    """``git describe --always --dirty`` of the installed tree, cached
+    after the first call; None outside a git checkout (deployed wheels) —
+    the /healthz body then simply omits a build id."""
+    if not _GIT_DESCRIBE:
+        describe: str | None = None
+        try:
+            import subprocess
+
+            out = subprocess.run(
+                ["git", "describe", "--always", "--dirty"],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True,
+                timeout=5,
+            )
+            if out.returncode == 0:
+                describe = out.stdout.decode("utf-8", "replace").strip() or None
+        except Exception:
+            describe = None
+        _GIT_DESCRIBE.append(describe)
+    return _GIT_DESCRIBE[0]
 
 
 class MetricsExporter:
@@ -53,6 +83,11 @@ class MetricsExporter:
             assert self.bound_port is not None
             return self.bound_port
         registry = self.registry
+        t_started = time.monotonic()
+        # Resolved ONCE at start, off the request path: a liveness probe
+        # must never block on a subprocess (git can hang on a network
+        # filesystem for longer than a load balancer's timeout).
+        git_id = git_describe()
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (stdlib API name)
@@ -65,9 +100,26 @@ class MetricsExporter:
                     self.end_headers()
                     self.wfile.write(body)
                 elif path == "/healthz":
-                    body = b"ok\n"
+                    # JSON body (round 16) so a load balancer — and the
+                    # soak — can tell "up" from "warm": family count > 0
+                    # means the planes have instrumented, spans_installed
+                    # means traces are being recorded.
+                    from fedcrack_tpu.obs import spans as _spans
+
+                    payload = {
+                        "status": "ok",
+                        "families": len(registry.families()),
+                        "uptime_seconds": round(
+                            time.monotonic() - t_started, 3
+                        ),
+                        "spans_installed": _spans.current() is not None,
+                        "git": git_id,
+                    }
+                    body = (
+                        json.dumps(payload, sort_keys=True) + "\n"
+                    ).encode("utf-8")
                     self.send_response(200)
-                    self.send_header("Content-Type", "text/plain; charset=utf-8")
+                    self.send_header("Content-Type", HEALTH_CONTENT_TYPE)
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)

@@ -160,6 +160,9 @@ class MicroBatcher:
         )
         self._last_batch_end: float | None = None
         self._last_version: int | None = None
+        # Versions whose first served batch already linked to its swap span
+        # (round 16) — linking once per version keeps span files lean.
+        self._linked_versions: set[int] = set()
         self.swap_gaps_ms: list[float] = []
         self._running = True
         self._workers = [
@@ -250,18 +253,37 @@ class MicroBatcher:
                         self._counts["batch_retries"] += 1
                     self._m_retries.inc()
                     continue
+            # Round 16: the FIRST batch served on a freshly swapped version
+            # joins the swap's version-lineage trace and links to its span
+            # — closing the train→serve chain the stitcher reconstructs.
+            # Later batches keep the per-bucket trace.
+            span_route = {"trace": f"bucket-{size}"}
+            swap_ctx_of = getattr(self.weights, "swap_context", None)
+            if swap_ctx_of is not None:
+                with self._lock:
+                    first_on_version = version not in self._linked_versions
+                    if first_on_version:
+                        self._linked_versions.add(version)
+                if first_on_version:
+                    wire = swap_ctx_of(version)
+                    parsed = tracing.TraceContext.from_wire(wire)
+                    if parsed is not None:
+                        span_route = {
+                            "trace": parsed.trace,
+                            "remote_parent": wire,
+                        }
             try:
                 # One span per dispatched batch, joined to its requests by
                 # their req-NNNNNN correlation ids and to the swap plane by
                 # model_version.
                 with tracing.span(
                     "serve.batch",
-                    trace=f"bucket-{size}",
                     bucket=size,
                     n=len(batch),
                     attempt=attempt,
                     model_version=version,
                     requests=[r.trace for r in batch],
+                    **span_route,
                 ):
                     t0 = time.monotonic()
                     probs = self.engine.predict_bucket(variables, images)
@@ -275,6 +297,11 @@ class MicroBatcher:
             self._resolve(batch, probs, version, t0, t1, size)
             return
         # Every attempt failed: requests error out loudly, never hang.
+        from fedcrack_tpu.obs import flight
+
+        flight.note(
+            "serve.batch_failed", bucket=size, n=len(batch), error=repr(last_err)
+        )
         with self._lock:
             self._counts["failed"] += len(batch)
         self._m_failed.inc(len(batch))

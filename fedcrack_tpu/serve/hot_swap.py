@@ -133,6 +133,10 @@ class ModelVersionManager:
         self._lock = make_lock("serve.hot_swap.snapshot")
         self._current = (int(initial_version), engine.prepare(initial_variables))
         self._ckptr = None
+        # Swap wire contexts by installed version (round 16): the batcher
+        # links the FIRST batch served on a version to its swap span via
+        # swap_context(). Bounded — only recent versions matter.
+        self._swap_ctx: dict[int, str] = {}
         self.swaps: list[dict] = []
         self.last_swap: dict | None = None
         self._thread: threading.Thread | None = None
@@ -143,6 +147,13 @@ class ModelVersionManager:
     def snapshot(self) -> tuple[int, Any]:
         with self._lock:
             return self._current
+
+    def swap_context(self, version: int) -> str | None:
+        """The wire context of the swap that installed ``version`` (None
+        for the initial weights or long-evicted versions) — what the first
+        batch served on a version links its span to."""
+        with self._lock:
+            return self._swap_ctx.get(int(version))
 
     @property
     def version(self) -> int:
@@ -210,9 +221,20 @@ class ModelVersionManager:
         current_version = self.snapshot()[0]
         if version <= current_version:
             return False
+        # Round 16: the swap joins the version-lineage trace and links to
+        # the flush that PUBLISHED this version — whose context is
+        # deterministic (spans.flush_context), so the link needs nothing
+        # beyond the version counter the statefile/checkpoint already
+        # carries. A version published by something other than a flush
+        # (harness publish, checkpoint import) leaves the link dangling —
+        # the stitcher reports it unresolved, nothing breaks.
+        fctx = tracing.flush_context(version)
+        sctx = tracing.TraceContext(fctx.trace, f"swap:v{version}")
         with tracing.span(
             "serve.swap",
-            trace=f"swap-v{version}",
+            trace=fctx.trace,
+            ctx=sctx.to_wire(),
+            remote_parent=fctx.to_wire(),
             from_version=current_version,
             to_version=version,
         ) as span_handle:
@@ -227,9 +249,22 @@ class ModelVersionManager:
                     if span_handle is not None:
                         span_handle.set(installed=False)
                     return False
+                # Context registered in the SAME locked section as the
+                # pointer flip: a batch snapshotting the new version right
+                # after the flip must find its swap_context (the batcher's
+                # first-batch link is one-shot — a miss is permanent).
+                self._swap_ctx[version] = sctx.to_wire()
+                while len(self._swap_ctx) > 8:
+                    self._swap_ctx.pop(min(self._swap_ctx))
                 self._current = (version, device_variables)
             if span_handle is not None:
                 span_handle.set(installed=True)
+        from fedcrack_tpu.obs import flight
+
+        flight.note(
+            "serve.swap", from_version=current_version, to_version=version,
+            load_ms=round(load_ms, 3),
+        )
         REGISTRY.counter(
             "serve_swaps_total", "hot swaps installed by the version manager"
         ).inc()

@@ -734,14 +734,29 @@ def main(argv=None) -> int:
     p.add_argument("--out", required=True)
     p.add_argument("--rounds", type=int, default=3)
     args = p.parse_args(argv)
-    artifact = {
-        "generated_by": "fedcrack_tpu.tools.chaos_drill",
-        "kill_restart": run_kill_restart_drill(rounds=args.rounds),
-        "corrupt_frame": run_corrupt_frame_drill(),
-        "edge_crash": run_edge_crash_drill(),
-        "straggler_storm": run_straggler_storm_drill(),
-        "buffered_kill": run_buffered_kill_drill(),
-    }
+    # Flight recorder (round 16): the drills feed the ring for free (fault
+    # injections via FaultPlan.take, fed-plane transitions, spans); a drill
+    # that dies ships its last-N-seconds history next to the traceback
+    # instead of just final counters.
+    from fedcrack_tpu.obs import flight
+
+    flight_path = os.path.abspath(f"{args.out}.flight.json")
+    flight.install(path=flight_path)
+    try:
+        artifact = {
+            "generated_by": "fedcrack_tpu.tools.chaos_drill",
+            "kill_restart": run_kill_restart_drill(rounds=args.rounds),
+            "corrupt_frame": run_corrupt_frame_drill(),
+            "edge_crash": run_edge_crash_drill(),
+            "straggler_storm": run_straggler_storm_drill(),
+            "buffered_kill": run_buffered_kill_drill(),
+        }
+    except BaseException:
+        flight.dump("chaos drill failed")
+        print(f"drill failed; flight record at {flight_path}", file=sys.stderr)
+        raise
+    finally:
+        flight.uninstall()
     os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=1, sort_keys=True)

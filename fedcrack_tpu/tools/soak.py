@@ -152,9 +152,17 @@ def run_soak(
     topk_fraction: float = 0.25,
     kill_restart: bool = True,
     rss_slack_bytes: int = 256 * 1024 * 1024,
+    slo_rules: str | None = None,
 ) -> dict:
     """Run the concurrent mini-soak for ``duration_s`` of traffic wall
-    (warmup/compile excluded) and return the audit artifact."""
+    (warmup/compile excluded) and return the audit artifact.
+
+    Round 16 adds the watchdog + flight-recorder + tracing layer: SLO
+    rules (``slo_rules`` = a configs/slo_*.json path, default the built-in
+    set) are machine-evaluated DURING the run, a breach dumps the flight
+    ring and fails the audit, and the span JSONL is stitched into
+    end-to-end update-lifecycle chains (client → root → serve under one
+    trace id) embedded as the artifact's ``tracing`` arm."""
     import jax
 
     from fedcrack_tpu.chaos.plan import (
@@ -182,6 +190,9 @@ def run_soak(
     from fedcrack_tpu.transport.edge import raw_caller
     from fedcrack_tpu.transport.service import FedServer, ServerThread
 
+    from fedcrack_tpu.obs import flight
+    from fedcrack_tpu.obs.watchdog import Watchdog, load_rules
+
     ctx = tempfile.TemporaryDirectory(prefix="soak_") if workdir is None else None
     base_dir = ctx.name if ctx is not None else workdir
     os.makedirs(base_dir, exist_ok=True)
@@ -189,7 +200,13 @@ def run_soak(
     spans_path = os.path.join(base_dir, "spans.jsonl")
     serve_metrics_path = os.path.join(base_dir, "serve_metrics.jsonl")
     metrics_dump_path = os.path.join(base_dir, "metrics.prom")
-    tracing.install(spans_path)
+    flight_path = os.path.join(base_dir, "flight.json")
+    stitched_path = os.path.join(base_dir, "trace_stitched.json")
+    # Size-bounded span sink: an hours-long soak rotates instead of
+    # appending one unbounded JSONL (the stitcher reads the whole set).
+    tracing.install(spans_path, max_bytes=64 * 1024 * 1024, keep=3)
+    flight.install(path=flight_path)
+    watchdog = Watchdog(load_rules(slo_rules) if slo_rules else None)
 
     model_config = ModelConfig(
         img_size=32, stem_features=4, encoder_features=(8,),
@@ -325,7 +342,7 @@ def run_soak(
         """The edge-tier shard: two synthetic leaves fold into a buffered
         EdgeAggregator whose partials relay up to the SAME root."""
         from fedcrack_tpu.transport import transport_pb2 as pb
-        from fedcrack_tpu.transport.codec import decode_scalar_map
+        from fedcrack_tpu.transport.codec import decode_scalar_map, encode_scalar_map
 
         edge = EdgeAggregator(
             edge_id,
@@ -365,17 +382,34 @@ def run_soak(
                 base_tree = tree_from_bytes(edge.base_blob, template=template)
                 for leaf in ("l0", "l1"):
                     leaf_it += 1
-                    blob = tree_to_bytes(_perturb_tree(base_tree, rng))
+                    leaf_ctx = tracing.TraceContext(
+                        tracing.version_trace(edge.base_version),
+                        f"train:{leaf}:n{leaf_it}",
+                    )
+                    with tracing.span(
+                        "client.train",
+                        trace=leaf_ctx.trace,
+                        cname=leaf,
+                        ctx=leaf_ctx.to_wire(),
+                    ):
+                        blob = tree_to_bytes(_perturb_tree(base_tree, rng))
                     ok, _why = edge.offer_buffered(
-                        leaf, blob, 4 + leaf_it % 3, edge.base_version
+                        leaf, blob, 4 + leaf_it % 3, edge.base_version,
+                        trace_ctx=leaf_ctx.to_wire(),
                     )
                     edge_stats["accepted"] += bool(ok)
                 if edge.buffer_ready():
-                    partial, total, _info = edge.flush_partial()
+                    partial, total, info = edge.flush_partial()
                     msg = pb.ClientMessage(cname=edge_id)
                     msg.done.round = rnd
                     msg.done.weights = partial
                     msg.done.sample_count = total
+                    # The edge flush's wire context rides the hop up like
+                    # any client push's — the root re-parents it onto the
+                    # flush that folds this partial.
+                    encode_scalar_map(
+                        msg.done.metrics, {"__trace": info["trace_ctx"]}
+                    )
                     prep = call(msg)
                     edge_stats["flushes"] += 1
                     if prep.status == R.NOT_WAIT:
@@ -474,10 +508,18 @@ def run_soak(
     mid_scrape_families = 0
     kill_event: dict = {"killed": False}
     st_current = st
+    last_watchdog_eval = 0.0
     try:
         # Mid-soak: scrape our own endpoint while everything is in flight.
         while time.monotonic() < deadline:
             remaining = deadline - time.monotonic()
+            if time.monotonic() - last_watchdog_eval >= 0.5:
+                # The SLO watchdog rides the run: rules evaluated over the
+                # live registry every ~0.5 s; a breach dumps the flight
+                # ring immediately (Watchdog.enforce) — the audit verdict
+                # lands below.
+                last_watchdog_eval = time.monotonic()
+                watchdog.enforce()
             if kill_restart and not kill_event["killed"] and (
                 time.monotonic() - t_start >= duration_s * 0.45
             ):
@@ -520,6 +562,10 @@ def run_soak(
     with open(metrics_dump_path, "w", encoding="utf-8") as f:
         f.write(exposition)
     parsed = scrape(exporter.url)
+    # One final watchdog pass over the REAL scrape (the same text a
+    # dashboard would read), then the verdict.
+    watchdog.enforce(parsed)
+    watchdog_audit = watchdog.audit()
     exporter.stop()
     final_state = st_current.state
     tracing.uninstall()
@@ -575,6 +621,9 @@ def run_soak(
         "watermarks_steady": bool(leak.get("steady")),
         "recompiles_since_warmup": int(recompiles),
         "hung_threads": hung,
+        # Round 16: the machine-checked SLO verdict joins the audit — the
+        # rule set replaces what used to be hand-coded per-harness checks.
+        "watchdog_clean": bool(watchdog_audit["clean"]),
     }
     audit["clean"] = (
         audit["zero_torn_versions"]
@@ -584,6 +633,7 @@ def run_soak(
         and audit["watermarks_steady"]
         and recompiles == 0
         and not hung
+        and audit["watchdog_clean"]
     )
 
     def _sample(name: str, labels: dict | None = None):
@@ -592,11 +642,22 @@ def run_soak(
         return sample_value(parsed, name, labels)
 
     from fedcrack_tpu.obs.spans import read_spans
+    from fedcrack_tpu.tools.trace_stitch import stitch_files, summarize
 
     span_records = read_spans(spans_path)
     span_names: dict[str, int] = {}
     for rec in span_records:
         span_names[rec["name"]] = span_names.get(rec["name"], 0) + 1
+
+    # Stitch the span file into end-to-end update lifecycles: in this
+    # one-process harness the planes share a JSONL, but the joins are the
+    # SAME wire-context/version joins a multi-process deployment stitches
+    # across per-process files. The full result lands next to the spans
+    # for CI upload; the artifact embeds the summary.
+    stitched = stitch_files([spans_path])
+    with open(stitched_path, "w", encoding="utf-8") as f:
+        json.dump(stitched, f, indent=1, sort_keys=True, default=str)
+    tracing_summary = summarize(stitched)
 
     artifact = {
         "config": {
@@ -642,13 +703,25 @@ def run_soak(
             "exposition_bytes": len(exposition),
         },
         "spans": {"total": len(span_records), "by_name": dict(sorted(span_names.items()))},
+        "tracing": tracing_summary,
+        "watchdog": watchdog_audit,
         "audit": audit,
         "paths": {
             "metrics_dump": metrics_dump_path,
             "spans": spans_path,
             "statefile": state_path,
+            "flight": flight_path,
+            "stitched_trace": stitched_path,
         },
     }
+    if not audit["clean"] and not any(
+        d["reason"].startswith("watchdog") for d in (flight.current().dumps if flight.current() else [])
+    ):
+        # A failed audit ships its flight record even when no watchdog
+        # rule breached (e.g. a torn version or a leak): the dump is the
+        # red run's last-N-seconds history.
+        flight.dump("soak audit failed")
+    flight.uninstall()
     if ctx is not None:
         # Preserve nothing from a temp workdir (the artifact embeds the
         # numbers); named workdirs keep their dumps for CI upload.
@@ -668,9 +741,13 @@ def main(argv=None) -> int:
     p.add_argument("--codec", default="topk_delta")
     p.add_argument("--no-kill", action="store_true",
                    help="skip the mid-soak server kill -> restart")
+    p.add_argument("--slo-rules", default="",
+                   help="SLO watchdog rule file (configs/slo_*.json); "
+                   "empty = the built-in default set")
     p.add_argument("--workdir", default="",
-                   help="keep dumps (metrics.prom, spans.jsonl) here; "
-                   "empty = temp dir, dumps discarded")
+                   help="keep dumps (metrics.prom, spans.jsonl, flight.json, "
+                   "trace_stitched.json) here; empty = temp dir, dumps "
+                   "discarded")
     p.add_argument("--out", default="", help="write the audit artifact JSON here")
     args = p.parse_args(argv)
     artifact = run_soak(
@@ -681,6 +758,7 @@ def main(argv=None) -> int:
         update_codec=args.codec,
         kill_restart=not args.no_kill,
         workdir=args.workdir or None,
+        slo_rules=args.slo_rules or None,
     )
     payload = json.dumps(artifact, indent=1, sort_keys=True)
     if args.out:
@@ -691,6 +769,12 @@ def main(argv=None) -> int:
         print(json.dumps(artifact["audit"], indent=1, sort_keys=True))
     else:
         print(payload)
+    if artifact["watchdog"]["breaches"]:
+        # The breach → flight-dump → exit-code contract (the dump already
+        # landed the moment the first breaching evaluation ran).
+        from fedcrack_tpu.obs.watchdog import BREACH_EXIT
+
+        return BREACH_EXIT
     return 0 if artifact["audit"]["clean"] else 1
 
 

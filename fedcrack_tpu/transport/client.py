@@ -253,7 +253,8 @@ class FedClient:
             msg = self._msg()
             msg.ready.SetInParent()
             encode_scalar_map(msg.ready.config, {"current_round": 0})
-            rep = self._call(method, msg)
+            with tracing.span("client.enroll", cname=self.cname):
+                rep = self._call(method, msg)
             cfg = decode_scalar_map(rep.config)
             if rep.status != R.SW:
                 log.info("%s not enrolled: %s", self.cname, rep.status)
@@ -296,12 +297,20 @@ class FedClient:
             msg = self._msg()
             msg.pull.SetInParent()
             with tracing.span(
-                "client.pull", trace=f"round-{current_round}", cname=self.cname
+                "client.pull",
+                trace=tracing.version_trace(model_version),
+                cname=self.cname,
             ):
                 weights = self._call(method, msg).weights
             self._count_wire("down", len(weights))
 
             while True:
+                # One trace id per update lifecycle, derived from the base
+                # version every party learns in-band (spans.version_trace):
+                # the flush that averages this round's uploads, the swap
+                # installing it and the first batch served from it all join
+                # the same trace — stitchable by tools/trace_stitch.py.
+                trace = tracing.version_trace(model_version)
                 # Phase 3: announce training (reference 'T', fl_client.py:106-107)
                 msg = self._msg()
                 msg.training.round = current_round
@@ -313,14 +322,24 @@ class FedClient:
                 # encode (trained - base) against it, pinned server-side by
                 # the frame's base_version == this round's model_version.
                 round_base = weights
-                if self._train_takes_hparams:
-                    weights, n_samples, metrics = self.train_fn(
-                        weights, current_round, self.server_hparams
-                    )
-                else:
-                    weights, n_samples, metrics = self.train_fn(
-                        weights, current_round
-                    )
+                train_ctx = tracing.TraceContext(
+                    trace, f"train:{self.cname}:r{current_round}"
+                )
+                with tracing.span(
+                    "client.train",
+                    trace=trace,
+                    cname=self.cname,
+                    round=current_round,
+                    ctx=train_ctx.to_wire(),
+                ) as train_span:
+                    if self._train_takes_hparams:
+                        weights, n_samples, metrics = self.train_fn(
+                            weights, current_round, self.server_hparams
+                        )
+                    else:
+                        weights, n_samples, metrics = self.train_fn(
+                            weights, current_round
+                        )
 
                 # Phase 5: report (reference 'D', fl_client.py:124-127).
                 # The upload is the codec's encoding; local `weights` stay
@@ -346,13 +365,27 @@ class FedClient:
                     msg.done.metrics,
                     {k: float(v) for k, v in metrics.items()},
                 )
+                # In-band trace propagation (round 16): the push's wire
+                # context rides the metrics map like every other in-band
+                # field — the server re-parents it onto the flush span.
+                # Attached only when tracing is live; the key never
+                # collides with a training metric (floats only above).
+                push_ctx = tracing.TraceContext(
+                    trace, f"push:{self.cname}:r{current_round}"
+                )
+                if tracing.current() is not None:
+                    encode_scalar_map(
+                        msg.done.metrics, {"__trace": push_ctx.to_wire()}
+                    )
                 self._count_wire("up", len(upload), self.codec.name)
                 with tracing.span(
                     "client.push",
-                    trace=f"round-{current_round}",
+                    trace=trace,
+                    parent=train_span.span_id if train_span else None,
                     cname=self.cname,
                     upload_bytes=len(upload),
                     codec=self.codec.name,
+                    ctx=push_ctx.to_wire(),
                 ):
                     rep = self._call(method, msg)
 
@@ -401,6 +434,7 @@ class FedClient:
         too stale and will never be averaged — codec cross-round state
         rolls back, exactly the sync straggler contract); ``REJECTED`` is
         sanitation failing loudly; ``FIN`` carries the final global."""
+        push_seq = 0
         while True:
             msg = self._msg()
             msg.pull.SetInParent()
@@ -411,6 +445,11 @@ class FedClient:
             pcfg = decode_scalar_map(rep.config)
             base_version = int(pcfg.get("model_version", 0))
             current_round = int(pcfg.get("current_round", 1))
+            # Buffered sessions push many times per client: the lifecycle
+            # trace keys on the PULLED base version (the flush that folds
+            # this update publishes base+k on the same lineage), and the
+            # push sequence keeps the wire context unique per upload.
+            trace = tracing.version_trace(base_version)
             if current_round > max_rounds:
                 # The federation finished between our last push and this
                 # pull: the blob IS the final global.
@@ -418,12 +457,25 @@ class FedClient:
                 self._upload_all(method)
                 return result
 
-            if self._train_takes_hparams:
-                trained, n_samples, metrics = self.train_fn(
-                    weights, current_round, self.server_hparams
-                )
-            else:
-                trained, n_samples, metrics = self.train_fn(weights, current_round)
+            push_seq += 1
+            train_ctx = tracing.TraceContext(
+                trace, f"train:{self.cname}:n{push_seq}"
+            )
+            with tracing.span(
+                "client.train",
+                trace=trace,
+                cname=self.cname,
+                round=current_round,
+                ctx=train_ctx.to_wire(),
+            ) as train_span:
+                if self._train_takes_hparams:
+                    trained, n_samples, metrics = self.train_fn(
+                        weights, current_round, self.server_hparams
+                    )
+                else:
+                    trained, n_samples, metrics = self.train_fn(
+                        weights, current_round
+                    )
 
             upload = self.codec.encode_update(
                 trained,
@@ -438,13 +490,22 @@ class FedClient:
             encode_scalar_map(
                 msg.done.metrics, {k: float(v) for k, v in metrics.items()}
             )
+            push_ctx = tracing.TraceContext(
+                trace, f"push:{self.cname}:n{push_seq}"
+            )
+            if tracing.current() is not None:
+                encode_scalar_map(
+                    msg.done.metrics, {"__trace": push_ctx.to_wire()}
+                )
             self._count_wire("up", len(upload), self.codec.name)
             with tracing.span(
                 "client.push",
-                trace=f"round-{current_round}",
+                trace=trace,
+                parent=train_span.span_id if train_span else None,
                 cname=self.cname,
                 upload_bytes=len(upload),
                 codec=self.codec.name,
+                ctx=push_ctx.to_wire(),
             ):
                 rep = self._call(method, msg)
             result.history.append(

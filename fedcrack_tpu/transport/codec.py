@@ -69,12 +69,22 @@ def event_from_message(msg: pb.ClientMessage, now: float) -> R.Event:
             offset=msg.log.offset,
         )
     if kind == "done":
+        # In-band trace context (round 16): the push's wire context rides
+        # the metrics map under "__trace". Anything that is not a plain
+        # string degrades to "no context" — a corrupted context must cost
+        # the sender its span parentage, never the upload.
+        trace_ctx = ""
+        if "__trace" in msg.done.metrics:
+            scalar = msg.done.metrics["__trace"]
+            if scalar.WhichOneof("value") == "as_string":
+                trace_ctx = scalar.as_string
         return R.TrainDone(
             cname=cname,
             round=msg.done.round,
             blob=msg.done.weights,
             num_samples=msg.done.sample_count,
             now=now,
+            trace_ctx=trace_ctx,
         )
     if kind == "poll":
         return R.VersionPoll(

@@ -85,16 +85,28 @@ class EdgeRelay:
         return self._call(msg).weights
 
     def push_partial(
-        self, round_idx: int, blob: bytes, total_samples: int
+        self,
+        round_idx: int,
+        blob: bytes,
+        total_samples: int,
+        trace_ctx: str = "",
     ) -> tuple[str, bytes, dict]:
         """Report the shard's partial average for ``round_idx``. Returns
         ``(status, new_global_blob_or_empty, config)`` — RESP_ARY/FIN carry
         the root's round average, which the edge adopts as its leaves'
-        next base (never its own partial)."""
+        next base (never its own partial). ``trace_ctx`` (round 16) is the
+        edge flush span's wire context (``EdgeAggregator.last_partial_ctx``
+        / ``flush_partial``'s ``info["trace_ctx"]``), carried in-band so
+        the root re-parents the edge onto its flush span exactly like a
+        client push."""
+        from fedcrack_tpu.transport.codec import encode_scalar_map
+
         msg = self._msg()
         msg.done.round = int(round_idx)
         msg.done.weights = blob
         msg.done.sample_count = int(total_samples)
+        if trace_ctx:
+            encode_scalar_map(msg.done.metrics, {"__trace": trace_ctx})
         rep = self._call(msg)
         return rep.status, rep.weights, dict(decode_scalar_map(rep.config))
 

@@ -30,6 +30,7 @@ import grpc
 
 from fedcrack_tpu.configs import FedConfig
 from fedcrack_tpu.fed import rounds as R
+from fedcrack_tpu.obs import flight
 from fedcrack_tpu.obs import spans as tracing
 from fedcrack_tpu.obs.registry import DEFAULT_VERSIONS_BUCKETS, REGISTRY
 from fedcrack_tpu.transport import transport_pb2 as pb
@@ -68,6 +69,15 @@ def observe_transition(
     (microseconds); nothing here touches the reply path's latency budget.
     """
     if isinstance(event, R.TrainDone):
+        # Flight-recorder feed (round 16): one compact event per update
+        # outcome — a post-mortem's last-N-seconds view of the fed plane.
+        flight.note(
+            "fed.update",
+            cname=event.cname,
+            round=event.round,
+            status=reply.status,
+            bytes=len(event.blob),
+        )
         updates = REGISTRY.counter(
             "fed_updates_total",
             "client updates by outcome: accepted into the round/buffer, "
@@ -116,6 +126,12 @@ def observe_transition(
             "buffer fill as a fraction of buffer_k (1.0 = flush imminent)",
         ).set(len(state.buffer) / state.config.buffer_k)
     if state.model_version != prev.model_version:
+        flight.note(
+            "fed.flush",
+            version=state.model_version,
+            round=prev.current_round,
+            wall_s=round(wall_s, 6),
+        )
         REGISTRY.counter(
             "fed_global_versions_total",
             "global model version publishes (sync aggregations + buffered "
@@ -355,6 +371,13 @@ class FedServer:
         self._state_lock = asyncio.Lock()
         self._state_pending: R.ServerState | None = None
         self._bg_tasks: set[asyncio.Task] = set()
+        # Cross-process trace links (round 16): the wire context each
+        # client's latest accepted upload carried, re-parented onto the
+        # flush span that averages it. Pure observability — never
+        # persisted (a restart degrades the flush to fewer links, exactly
+        # the dropped-context contract), so statefile bytes stay a pure
+        # function of protocol state.
+        self._trace_links: dict[str, str] = {}
         self._server: grpc.aio.Server | None = None
         self._tick_task: asyncio.Task | None = None
         self.bound_port: int | None = None
@@ -405,17 +428,44 @@ class FedServer:
             if self.state.phase == R.PHASE_FINISHED:
                 self.finished.set()
             state = self.state
+            if (
+                isinstance(event, R.TrainDone)
+                and event.trace_ctx
+                and reply.status in (R.RESP_ACY, R.RESP_ARY, R.FIN)
+                and tracing.TraceContext.from_wire(event.trace_ctx) is not None
+            ):
+                # Accepted upload carrying a parseable wire context: stamp
+                # it for the flush that will average it. A malformed
+                # context was already degraded to "" at the transport edge
+                # or fails from_wire here — parentless, never an error.
+                self._trace_links[event.cname] = event.trace_ctx
         try:
             observe_transition(prev_state, state, event, reply, apply_s)
         except Exception:  # telemetry must never break the protocol
             log.exception("metric observation failed; protocol unaffected")
         if state.model_version != prev_version:
             # Zero-duration correlation marker: the flush/aggregation span
-            # for trace `round-N` (the transition itself was timed above).
+            # (the transition itself was timed above). Round 16: it lives
+            # on the version-lineage trace with the DETERMINISTIC context
+            # `flush:vV` (spans.flush_context — the serve plane links its
+            # swap to it from the statefile's version alone), and carries
+            # the originating clients' wire contexts as `links`, so ONE
+            # trace id follows client train → push → flush → swap → first
+            # batch served.
+            entry = state.history[-1] if state.history else {}
+            links = []
+            for cname in entry.get("clients", ()):
+                wire = self._trace_links.pop(cname, None)
+                if wire is not None:
+                    links.append(wire)
+            fctx = tracing.flush_context(state.model_version)
             with tracing.span(
                 "fed.flush",
-                trace=f"round-{prev_state.current_round}",
+                trace=fctx.trace,
+                ctx=fctx.to_wire(),
+                links=sorted(links),
                 version=state.model_version,
+                round=prev_state.current_round,
                 apply_s=round(apply_s, 6),
             ):
                 pass

@@ -342,6 +342,37 @@ def test_obs001_metric_name_literal_with_unit_suffix():
     assert "OBS001" not in rule_ids(lint("stats.counter('whatever')\n"))
 
 
+def test_obs002_span_name_dotted_literal():
+    """OBS002 (round 16): tracing.span names must be dotted plane.verb
+    string literals — the literal-name contract extended to spans, so the
+    stitcher's plane census and `grep -r 'fed.flush'` both stay total."""
+    good = (
+        "from fedcrack_tpu.obs import spans as tracing\n"
+        "with tracing.span('client.push', trace='fedtr-v0'):\n"
+        "    pass\n"
+        "with tracing.span('edge.flush_partial', links=[]):\n"
+        "    pass\n"
+        "with tracing.span(name='serve.batch'):\n"
+        "    pass\n"
+    )
+    assert "OBS002" not in rule_ids(lint(good))
+    # Computed name: the span catalog becomes ungreppable.
+    computed = (
+        "from fedcrack_tpu.obs import spans as tracing\n"
+        "with tracing.span(f'serve.{verb}'):\n"
+        "    pass\n"
+    )
+    assert "OBS002" in rule_ids(lint(computed))
+    assert "OBS002" in rule_ids(lint("tracing.span(span_name)\n"))
+    # Undotted / free-spelled: no plane prefix to stitch or census by.
+    assert "OBS002" in rule_ids(lint("tracing.span('push')\n"))
+    assert "OBS002" in rule_ids(lint("spans.span('Client.Push')\n"))
+    assert "OBS002" in rule_ids(lint("tracing.span('fed.')\n"))
+    # Non-tracing receivers with a span method are not ours.
+    assert "OBS002" not in rule_ids(lint("rec.span('anything goes')\n"))
+    assert "OBS002" not in rule_ids(lint("soup.span('html')\n"))
+
+
 # ---- lock-order pack (project scope: lint_modules, not lint_source) ----
 
 CYCLE_SRC = """\

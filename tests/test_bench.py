@@ -375,6 +375,62 @@ def test_validate_detail_typed_checks():
     assert any(
         "planes_covered" in v for v in bench.validate_detail(obs_bad2)
     )
+    # Round-16 tracing/watchdog arms: ABSENT is fine (r15 artifacts predate
+    # them), but a present arm must carry the full sub-schema.
+    assert bench.validate_detail(obs_ok) == []
+    obs_r16 = json.loads(json.dumps(obs_ok))
+    obs_r16["observability"]["tracing"] = {
+        "records": 100, "traces": 5, "chains": 3, "n_complete": 1,
+        "complete": True, "trace": "fedtr-v0",
+        "planes_crossed": ["client", "fed", "serve"],
+        "stages": ["client.push", "fed.flush", "serve.batch", "serve.swap"],
+    }
+    obs_r16["observability"]["watchdog"] = {
+        "rules_evaluated": 6, "rules": ["a"], "evaluations": 9,
+        "never_determinate": [], "all_rules_evaluated": True,
+        "breaches": [], "clean": True,
+    }
+    assert bench.validate_detail(obs_r16) == []
+    obs_r16_bad = json.loads(json.dumps(obs_r16))
+    del obs_r16_bad["observability"]["tracing"]["complete"]
+    assert any(
+        "observability.tracing['complete']" in v
+        for v in bench.validate_detail(obs_r16_bad)
+    )
+    obs_r16_bad2 = json.loads(json.dumps(obs_r16))
+    obs_r16_bad2["observability"]["watchdog"]["breaches"] = 0
+    assert any(
+        "observability.watchdog['breaches']" in v
+        for v in bench.validate_detail(obs_r16_bad2)
+    )
+
+
+def test_committed_r16_artifact_has_stitched_trace_and_watchdog_audit():
+    """The round-16 acceptance pin: the committed soak/bench artifact holds
+    at least one stitched trace whose chain crosses >= 3 planes (client,
+    root/fed, serve) under a single trace id, and a clean machine-checked
+    watchdog audit with every rule evaluated."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    run_dir = os.path.join(root, "bench_runs")
+    candidates = [
+        n for n in sorted(os.listdir(run_dir))
+        if n.startswith("r16_") and n.endswith(".json")
+    ]
+    assert candidates, "no committed r16 artifact"
+    with open(os.path.join(run_dir, candidates[0])) as f:
+        art = json.load(f)
+    obsy = art["detail"]["observability"]
+    tr = obsy["tracing"]
+    assert tr["complete"] and tr["n_complete"] >= 1
+    assert tr["trace"].startswith("fedtr-v")
+    assert {"client", "fed", "serve"} <= set(tr["planes_crossed"])
+    for stage in ("fed.flush", "serve.swap", "serve.batch"):
+        assert stage in tr["stages"], stage
+    assert {"client.push", "edge.flush_partial"} & set(tr["stages"])
+    wd = obsy["watchdog"]
+    assert wd["clean"] and wd["all_rules_evaluated"] and wd["breaches"] == []
+    assert wd["evaluations"] > 1 and wd["rules_evaluated"] >= 5
+    assert obsy["audit"]["watchdog_clean"] and obsy["audit"]["clean"]
 
 
 def test_compact_summary_last_line_parses():

@@ -159,11 +159,18 @@ def test_http_scrape_round_trips_every_sample():
         body = req.read().decode("utf-8")
         assert body == reg.exposition()
         parsed = scrape(exporter.url)
-        # liveness + 404 routes
+        # liveness + 404 routes: /healthz answers a JSON body (round 16)
+        # so "up" and "warm" are distinguishable.
         health = urllib.request.urlopen(
             exporter.url.replace("/metrics", "/healthz"), timeout=5
         )
-        assert health.read() == b"ok\n"
+        assert health.headers["Content-Type"].startswith("application/json")
+        body = json.loads(health.read())
+        assert body["status"] == "ok"
+        assert body["families"] == 3  # the three families _populate built
+        assert body["uptime_seconds"] >= 0
+        assert isinstance(body["spans_installed"], bool)
+        assert "git" in body  # a string in a checkout, null in a wheel
         with pytest.raises(urllib.error.HTTPError):
             urllib.request.urlopen(
                 exporter.url.replace("/metrics", "/nope"), timeout=5
@@ -294,6 +301,48 @@ def test_spans_correlate_and_record_monotonic_durations(tmp_path):
         assert h is None  # uninstalled -> no-op, sites never branch
 
 
+def test_span_recorder_rotation_never_tears_a_line(tmp_path):
+    """Satellite (round 16): size-based rotation bounds an hours-long
+    soak's JSONL; every file in the rotated set holds only whole JSON
+    lines, at most keep+1 files exist, and the record stream survives."""
+    path = tmp_path / "spans.jsonl"
+    with tracing.SpanRecorder(path, max_bytes=1500, keep=2) as rec:
+        for i in range(60):
+            with rec.span("w.x", trace=f"t-{i}", payload="p" * 64):
+                pass
+    files = tracing.span_files(path)
+    assert str(path) in files
+    assert 2 <= len(files) <= 3  # rotated at least once, keep=2 honored
+    assert not (tmp_path / "spans.jsonl.3").exists()
+    total = 0
+    for f in files:
+        text = open(f, encoding="utf-8").read()
+        assert text.endswith("\n")  # no torn tail
+        for line in text.splitlines():
+            rec_obj = json.loads(line)  # every line strict JSON
+            assert rec_obj["name"] == "w.x"
+            total += 1
+        import os as _os
+
+        assert _os.path.getsize(f) <= 1500 + 200  # one-line slack
+    assert 0 < total <= 60  # keep=2 may have dropped the oldest lines
+    # span_files orders oldest → newest: the newest record is in the last.
+    last = tracing.read_spans(files[-1])
+    assert last[-1]["trace"] == "t-59"
+
+
+def test_trace_context_wire_round_trip_and_degradation():
+    ctx = tracing.TraceContext("fedtr-v7", "push:c0:r3")
+    assert tracing.TraceContext.from_wire(ctx.to_wire()) == ctx
+    assert tracing.version_trace(7) == "fedtr-v7"
+    assert tracing.flush_context(8) == tracing.TraceContext(
+        "fedtr-v7", "flush:v8"
+    )
+    # The dropped-context contract: anything malformed parses to None.
+    for garbage in (None, 7, b"x#y", "", "nohash", "#", "a#", "#b", "x" * 500):
+        assert tracing.TraceContext.from_wire(garbage) is None
+
+
 def test_span_recorder_thread_safe(tmp_path):
     path = tmp_path / "spans.jsonl"
     with tracing.SpanRecorder(path) as rec:
@@ -345,12 +394,222 @@ def test_leak_sentry_real_process_watermarks():
         sentries.LeakSentry(registry=MetricsRegistry()).deltas()
 
 
+# ---- flight recorder (round 16) ----
+
+
+def test_flight_ring_bounded_and_spans_feed_it(tmp_path):
+    from fedcrack_tpu.obs import flight
+
+    ring = flight.install(path=str(tmp_path / "flight.json"), capacity=8)
+    try:
+        for i in range(20):
+            flight.note("x", i=i)
+        events = ring.snapshot()
+        assert len(events) == 8  # bounded ring: only the last 8 survive
+        assert [e["i"] for e in events] == list(range(12, 20))
+        assert ring._seen == 20
+        # Spans feed the ring for FREE even with NO span recorder installed.
+        assert tracing.current() is None
+        with tracing.span("fed.flush", trace="fedtr-v1", ctx="fedtr-v1#flush:v2"):
+            pass
+        last = ring.snapshot()[-1]
+        assert last["kind"] == "span" and last["name"] == "fed.flush"
+        assert last["ctx"] == "fedtr-v1#flush:v2" and last["dur_s"] >= 0
+        path = flight.dump("unit test")
+        payload = json.loads(open(path).read())
+        assert payload["reason"] == "unit test"
+        assert payload["events_seen"] == 21
+        assert payload["events"][-1]["kind"] == "span"
+        assert "metrics_exposition" in payload
+    finally:
+        flight.uninstall()
+    assert flight.current() is None
+    flight.note("after", x=1)  # uninstalled: a no-op, never an error
+    assert flight.dump("after") is None
+
+
+@pytest.mark.filterwarnings("ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_flight_dump_on_thread_crash_and_sigusr2(tmp_path):
+    """The dump triggers: an unhandled exception in a thread and SIGUSR2
+    both write the ring to disk (the excepthooks are chained, so default
+    reporting still happens)."""
+    import os
+    import signal
+    import time as _time
+
+    from fedcrack_tpu.obs import flight
+
+    path = str(tmp_path / "flight.json")
+    flight.install(path=path, capacity=64)
+    try:
+        flight.note("before_crash", detail="context the post-mortem needs")
+
+        def boom():
+            raise RuntimeError("injected thread death")
+
+        t = threading.Thread(target=boom, name="doomed")
+        t.start()
+        t.join()
+        payload = json.loads(open(path).read())
+        assert "injected thread death" in payload["reason"]
+        assert any(e["kind"] == "before_crash" for e in payload["events"])
+        if hasattr(signal, "SIGUSR2"):
+            os.remove(path)
+            os.kill(os.getpid(), signal.SIGUSR2)
+            for _ in range(100):  # delivery is asynchronous-ish; bounded wait
+                if os.path.exists(path):
+                    break
+                _time.sleep(0.01)
+            payload = json.loads(open(path).read())
+            assert payload["reason"] == "SIGUSR2"
+    finally:
+        flight.uninstall()
+
+
+# ---- SLO watchdog (round 16) ----
+
+
+def _watchdog_registry():
+    reg = MetricsRegistry()
+    reg.counter("fed_updates_total", "u", labels=("result",)).labels(
+        result="accepted"
+    ).inc(10)
+    h = reg.histogram("serve_request_seconds", "lat", buckets=(0.1, 1.0, 10.0))
+    for v in [0.05] * 90 + [0.5] * 9 + [5.0]:
+        h.observe(v)
+    reg.gauge("serve_recompiles_total", "r").set(0)
+    return reg
+
+
+def test_watchdog_stats_value_quantile_and_rate(monkeypatch):
+    from fedcrack_tpu.obs import watchdog as wdm
+
+    reg = _watchdog_registry()
+    rules = [
+        wdm.SloRule(name="v", metric="fed_updates_total",
+                    labels={"result": "accepted"}, op=">=", threshold=10),
+        wdm.SloRule(name="p95", metric="serve_request_seconds", stat="p95",
+                    op="<=", threshold=1.0),
+        wdm.SloRule(name="p50", metric="serve_request_seconds", stat="p50",
+                    op="<=", threshold=0.1),
+        wdm.SloRule(name="n", metric="serve_request_seconds", stat="count",
+                    op="==", threshold=100),
+        wdm.SloRule(name="rate", metric="fed_updates_total",
+                    labels={"result": "accepted"}, stat="rate", op=">=",
+                    threshold=1.0, min_elapsed_s=0.01),
+        wdm.SloRule(name="absent", metric="no_such_total", op="==", threshold=0),
+    ]
+    wd = wdm.Watchdog(rules, registry=reg)
+    r1 = {r["rule"]: r for r in wd.evaluate()["results"]}
+    assert r1["v"]["value"] == 10 and r1["v"]["ok"]
+    # p95 sits in the (0.1, 1.0] bucket: 90 of 100 below 0.1, 99 below 1.0.
+    assert 0.1 < r1["p95"]["value"] <= 1.0 and r1["p95"]["ok"]
+    assert r1["p50"]["value"] <= 0.1 and r1["p50"]["ok"]
+    assert r1["n"]["value"] == 100
+    assert r1["rate"]["value"] is None  # first evaluation: no window yet
+    assert r1["absent"]["value"] is None and r1["absent"]["breach"] is False
+    import time as _time
+
+    _time.sleep(0.02)
+    reg.counter("fed_updates_total", labels=("result",)).labels(
+        result="accepted"
+    ).inc(5)
+    r2 = {r["rule"]: r for r in wd.evaluate()["results"]}
+    assert r2["rate"]["value"] > 0 and r2["rate"]["ok"]
+    audit = wd.audit()
+    assert audit["breaches"] == [] and audit["evaluations"] == 2
+    assert audit["never_determinate"] == ["absent"]
+    assert not audit["all_rules_evaluated"] and not audit["clean"]
+
+
+def test_watchdog_consecutive_rides_out_blips():
+    """The `for:`-style clause: consecutive=3 means two failing
+    evaluations with a recovery between them never breach; three in a row
+    do. A bursty plane (storm gust, kill→restart window) must not page."""
+    from fedcrack_tpu.obs import watchdog as wdm
+
+    reg = MetricsRegistry()
+    g = reg.gauge("fed_buffer_fill_ratio", "fill")
+    rule = wdm.SloRule(
+        name="floor", metric="fed_buffer_fill_ratio", op=">=",
+        threshold=1.0, consecutive=3,
+    )
+    wd = wdm.Watchdog([rule], registry=reg)
+
+    def one(value):
+        g.set(value)
+        return wd.evaluate()["breaches"]
+
+    assert one(0.0) == []          # fail #1
+    assert one(0.0) == []          # fail #2
+    assert one(2.0) == []          # recovery resets the streak
+    assert one(0.0) == []          # fail #1 again
+    assert one(0.0) == []          # fail #2
+    assert one(0.0) != []          # fail #3: SUSTAINED -> breach
+    audit = wd.audit()
+    assert len(audit["breaches"]) == 1 and not audit["clean"]
+    with pytest.raises(ValueError, match="consecutive"):
+        wdm.SloRule(name="x", metric="y_total", op="==", threshold=0,
+                    consecutive=0)
+
+
+def test_watchdog_breach_dumps_flight_and_audits_dirty(tmp_path):
+    from fedcrack_tpu.obs import flight
+    from fedcrack_tpu.obs import watchdog as wdm
+
+    reg = _watchdog_registry()
+    rules = [
+        wdm.SloRule(name="impossible", metric="fed_updates_total",
+                    labels={"result": "accepted"}, op=">=", threshold=1e12,
+                    on_missing="breach"),
+    ]
+    path = str(tmp_path / "flight.json")
+    flight.install(path=path)
+    try:
+        wd = wdm.Watchdog(rules, registry=reg)
+        report = wd.enforce()
+        assert report["breaches"][0]["rule"] == "impossible"
+        payload = json.loads(open(path).read())
+        assert payload["reason"] == "watchdog breach: impossible"
+        # Watchdog samples themselves feed the ring (metric-sample deltas).
+        assert any(e["kind"] == "watchdog.eval" for e in payload["events"])
+        wd.enforce()  # a second breach does not re-dump (once per watchdog)
+        audit = wd.audit()
+        assert not audit["clean"] and len(audit["breaches"]) == 2
+    finally:
+        flight.uninstall()
+    assert wdm.BREACH_EXIT != 0
+
+
+def test_watchdog_rule_files_parse_and_default_config_matches():
+    """configs/slo_default.json must stay the mirror of the built-in rule
+    set; malformed rule files fail loudly."""
+    import os
+
+    from fedcrack_tpu.obs import watchdog as wdm
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    loaded = wdm.load_rules(os.path.join(root, "configs", "slo_default.json"))
+    assert loaded == wdm.default_rules()
+    smoke = wdm.load_rules(os.path.join(root, "configs", "slo_breach_smoke.json"))
+    assert smoke[0].on_missing == "breach" and smoke[0].threshold >= 1e12
+    with pytest.raises(ValueError, match="unknown op"):
+        wdm.SloRule(name="x", metric="y_total", op="~", threshold=1)
+    with pytest.raises(ValueError, match="unknown stat"):
+        wdm.SloRule(name="x", metric="y_total", op="<=", threshold=1, stat="p42")
+    with pytest.raises(ValueError, match="duplicate"):
+        wdm.Watchdog([wdm.SloRule(name="a", metric="x_total", op="==", threshold=0)] * 2)
+
+
 # ---- the concurrent mini-soak ----
 
 
 def _assert_soak_clean(artifact: dict):
     audit = artifact["audit"]
-    assert audit["clean"], json.dumps(audit, indent=1, sort_keys=True)
+    assert audit["clean"], json.dumps(
+        {"audit": audit, "watchdog": artifact["watchdog"]},
+        indent=1, sort_keys=True,
+    )
     assert audit["zero_torn_versions"] and audit["torn_versions"] == 0
     assert audit["serve_healthy"]
     assert audit["ef_mass_conserved"]
@@ -364,8 +623,23 @@ def _assert_soak_clean(artifact: dict):
     assert artifact["serve"]["failed"] == 0
     assert artifact["federation"]["flushes"] > 0
     assert artifact["spans"]["total"] > 0
-    for name in ("serve.batch", "fed.flush", "driver.round"):
+    for name in ("serve.batch", "fed.flush", "driver.round", "client.train"):
         assert artifact["spans"]["by_name"].get(name, 0) > 0, name
+    # Round 16: the machine-checked SLO audit and the stitched trace.
+    wd = artifact["watchdog"]
+    assert wd["clean"] and wd["all_rules_evaluated"], wd
+    assert wd["breaches"] == [] and wd["evaluations"] > 1
+    assert audit["watchdog_clean"]
+    tr = artifact["tracing"]
+    assert tr["complete"], tr
+    # One trace id crossed the client → root → serve planes.
+    assert {"client", "fed", "serve"} <= set(tr["planes_crossed"])
+    assert tr["trace"].startswith("fedtr-v")
+    for stage in ("fed.flush", "serve.swap", "serve.batch"):
+        assert stage in tr["stages"], (stage, tr)
+    # Upstream reached the flush via a direct push or an edge partial
+    # (the best chain may be either — both are client-plane-rooted).
+    assert {"client.push", "edge.flush_partial"} & set(tr["stages"]), tr
 
 
 def test_mini_soak_short_wall_clean_audit():

@@ -1,0 +1,282 @@
+"""Round-16 distributed tracing: cross-process context propagation over a
+real gRPC socket, stitched back into one chain by tools/trace_stitch.py.
+
+The load-bearing claims:
+
+- a client push's wire context (``"<trace>#<key>"`` in the TrainDone
+  metrics map) is re-parented onto the root's ``fed.flush`` span, an edge
+  re-parents its leaf offers onto its ``edge.flush_partial`` span and
+  forwards its OWN context up, so the stitcher reconstructs the full
+  ``client → edge → root → flush`` chain from the span JSONL;
+- the whole chain shares ONE trace id (``fedtr-v<base>`` — derived from
+  the in-band model version, no extra negotiation);
+- a deliberately dropped/corrupted context degrades to a parentless span:
+  the round closes normally, the flush simply links fewer parents, and
+  nothing anywhere raises;
+- the stitcher joins multiple per-process files (the deployment shape) and
+  its CLI enforces chain completeness via its exit code.
+"""
+
+import dataclasses
+import json
+import threading
+
+import numpy as np
+
+from fedcrack_tpu.configs import FedConfig
+from fedcrack_tpu.fed import rounds as R
+from fedcrack_tpu.fed.serialization import tree_to_bytes
+from fedcrack_tpu.fed.tree import EdgeAggregator
+from fedcrack_tpu.obs import spans as tracing
+from fedcrack_tpu.tools.trace_stitch import load_records, stitch, stitch_files, summarize
+from fedcrack_tpu.transport import FedClient, FedServer
+from fedcrack_tpu.transport import transport_pb2 as pb
+from fedcrack_tpu.transport.codec import encode_scalar_map, event_from_message
+from fedcrack_tpu.transport.edge import EdgeRelay, raw_caller
+from fedcrack_tpu.transport.service import ServerThread
+
+
+def _vars(value: float):
+    return {"params": {"w": np.full((4, 4), value, np.float32)}}
+
+
+def _trainer(delta: float):
+    def train(blob, rnd):
+        from fedcrack_tpu.fed.serialization import tree_from_bytes
+
+        tree = tree_from_bytes(blob)
+        tree["params"]["w"] = tree["params"]["w"] + delta
+        return tree_to_bytes(tree), 4, {"loss": float(rnd)}
+
+    return train
+
+
+def test_event_from_message_extracts_and_degrades_trace_ctx():
+    m = pb.ClientMessage(cname="c")
+    m.done.round = 1
+    m.done.weights = b"w"
+    m.done.sample_count = 3
+    encode_scalar_map(m.done.metrics, {"loss": 0.5, "__trace": "fedtr-v0#push:c:r1"})
+    ev = event_from_message(m, 1.0)
+    assert ev.trace_ctx == "fedtr-v0#push:c:r1"
+    # A non-string __trace (a poisoned/corrupted scalar) degrades to "".
+    m2 = pb.ClientMessage(cname="c")
+    m2.done.round = 1
+    m2.done.weights = b"w"
+    m2.done.sample_count = 3
+    encode_scalar_map(m2.done.metrics, {"__trace": 3.25})
+    assert event_from_message(m2, 1.0).trace_ctx == ""
+    # No context at all: the default, not an error.
+    m3 = pb.ClientMessage(cname="c")
+    m3.done.round = 1
+    m3.done.weights = b"w"
+    m3.done.sample_count = 3
+    assert event_from_message(m3, 1.0).trace_ctx == ""
+
+
+def test_trace_propagates_client_edge_root_over_grpc(tmp_path):
+    """The satellite scenario: 2 FedClients + 1 edge shard (2 leaf offers
+    relayed as one partial) against a real gRPC root; the stitched chain
+    covers client→edge→root under the round's single trace id."""
+    spans_path = tmp_path / "spans.jsonl"
+    tracing.install(spans_path)
+    cfg = FedConfig(
+        max_rounds=1,
+        cohort_size=3,
+        registration_window_s=5.0,
+        round_deadline_s=30.0,
+        poll_period_s=0.05,
+        port=0,
+    )
+    server = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
+    try:
+        with ServerThread(server) as st:
+            cfg_port = dataclasses.replace(cfg, port=st.port)
+            clients = [
+                FedClient(cfg_port, _trainer(0.1), cname="c0"),
+                FedClient(cfg_port, _trainer(0.2), cname="c1"),
+            ]
+            results = {}
+            threads = [
+                threading.Thread(
+                    target=lambda c=c: results.update({c.cname: c.run_session()})
+                )
+                for c in clients
+            ]
+            for t in threads:
+                t.start()
+
+            with EdgeRelay("edge-0", st.port) as relay:
+                handshake = relay.enroll()
+                base = relay.pull()
+                edge = EdgeAggregator("edge-0", server.state.template)
+                edge.begin_round(
+                    int(handshake["current_round"]),
+                    base,
+                    int(handshake["model_version"]),
+                    ["leaf-0", "leaf-1"],
+                )
+                for i, leaf in enumerate(("leaf-0", "leaf-1")):
+                    ctx = tracing.TraceContext(
+                        tracing.version_trace(edge.base_version),
+                        f"train:{leaf}:r1",
+                    )
+                    with tracing.span(
+                        "client.train", trace=ctx.trace, cname=leaf,
+                        ctx=ctx.to_wire(),
+                    ):
+                        blob = tree_to_bytes(_vars(0.3 + i / 10))
+                    ok, why = edge.offer(leaf, blob, 4, trace_ctx=ctx.to_wire())
+                    assert ok, why
+                partial, total = edge.partial()
+                assert edge.last_partial_ctx.startswith("fedtr-v0#edge:edge-0:")
+                status, _weights, _cfg = relay.push_partial(
+                    1, partial, total, trace_ctx=edge.last_partial_ctx
+                )
+                assert status in (R.RESP_ACY, R.RESP_ARY, R.FIN)
+            for t in threads:
+                t.join(timeout=30)
+            assert not any(t.is_alive() for t in threads)
+    finally:
+        tracing.uninstall()
+
+    assert results["c0"].rounds_completed == 1
+    stitched = stitch_files([str(spans_path)])
+    assert stitched["n_chains"] == 1
+    chain = stitched["chains"][0]
+    assert chain["trace"] == "fedtr-v0" and chain["version"] == 1
+    # All three uploads (2 clients + the edge partial) re-parented onto the
+    # flush; the edge entry resolves down to its two leaf offers.
+    assert len(chain["upstream"]) == 3
+    assert chain["unresolved_links"] == []
+    by_name = {}
+    for u in chain["upstream"]:
+        by_name.setdefault(u["span"]["name"], []).append(u)
+    assert len(by_name["client.push"]) == 2
+    (edge_entry,) = by_name["edge.flush_partial"]
+    assert [leaf["name"] for leaf in edge_entry["leaves"]] == [
+        "client.train", "client.train",
+    ]
+    # Local parentage: each push chains to its train span in-file.
+    for push in by_name["client.push"]:
+        assert push["train"] is not None
+        assert push["train"]["name"] == "client.train"
+    # Single trace id across every chain stage that exists (no serve plane
+    # in this session, so the chain is upstream-only and not "complete").
+    assert {"client", "edge", "fed"} <= set(chain["planes_crossed"])
+    assert not chain["complete"]
+
+
+def test_dropped_context_degrades_to_parentless_never_crashes(tmp_path):
+    """A garbage __trace (malformed string round 1, then a push with no
+    context round 2) must cost the sender its parentage, nothing else."""
+    spans_path = tmp_path / "spans.jsonl"
+    tracing.install(spans_path)
+    cfg = FedConfig(
+        max_rounds=2,
+        cohort_size=1,
+        registration_window_s=5.0,
+        round_deadline_s=30.0,
+        port=0,
+    )
+    server = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
+    try:
+        with ServerThread(server) as st:
+            channel, call = raw_caller(st.port)
+            msg = pb.ClientMessage(cname="raw")
+            msg.ready.SetInParent()
+            assert call(msg).status == R.SW
+            msg = pb.ClientMessage(cname="raw")
+            msg.pull.SetInParent()
+            base = call(msg).weights
+            for rnd, garbage in ((1, "not a context"), (2, None)):
+                msg = pb.ClientMessage(cname="raw")
+                msg.done.round = rnd
+                msg.done.weights = tree_to_bytes(_vars(0.5))
+                msg.done.sample_count = 2
+                if garbage is not None:
+                    encode_scalar_map(msg.done.metrics, {"__trace": garbage})
+                rep = call(msg)
+                assert rep.status in (R.RESP_ARY, R.FIN)
+            channel.close()
+            assert base  # the pull really happened
+    finally:
+        tracing.uninstall()
+    flushes = tracing.read_spans(spans_path, name="fed.flush")
+    assert len(flushes) == 2
+    for flush in flushes:
+        assert flush["links"] == []  # parentless, by design
+
+
+def _write_spans(path, records):
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+def _synthetic_chain_files(tmp_path, *, break_stage=None):
+    """Two per-process files (client vs server+serve) carrying one full
+    lifecycle; ``break_stage`` drops a stage to make the chain incomplete."""
+    trace = "fedtr-v4"
+    client = [
+        {"name": "client.train", "trace": trace, "span": 1, "parent": None,
+         "t": 0.0, "dur_s": 0.5, "ctx": f"{trace}#train:c0:r5"},
+        {"name": "client.push", "trace": trace, "span": 2, "parent": 1,
+         "t": 0.5, "dur_s": 0.1, "ctx": f"{trace}#push:c0:r5"},
+    ]
+    serverside = [
+        {"name": "fed.flush", "trace": trace, "span": 1, "parent": None,
+         "t": 0.7, "dur_s": 0.0, "version": 5, "round": 5,
+         "ctx": f"{trace}#flush:v5", "links": [f"{trace}#push:c0:r5"]},
+        {"name": "serve.swap", "trace": trace, "span": 2, "parent": None,
+         "t": 0.9, "dur_s": 0.02, "to_version": 5, "installed": True,
+         "ctx": f"{trace}#swap:v5", "remote_parent": f"{trace}#flush:v5"},
+        {"name": "serve.batch", "trace": trace, "span": 3, "parent": None,
+         "t": 1.0, "dur_s": 0.01, "model_version": 5,
+         "remote_parent": f"{trace}#swap:v5"},
+    ]
+    if break_stage is not None:
+        serverside = [r for r in serverside if r["name"] != break_stage]
+    a, b = tmp_path / "client.jsonl", tmp_path / "server.jsonl"
+    _write_spans(a, client)
+    _write_spans(b, serverside)
+    return [str(a), str(b)]
+
+
+def test_stitch_joins_per_process_files_into_a_complete_chain(tmp_path):
+    paths = _synthetic_chain_files(tmp_path)
+    stitched = stitch(load_records(paths))
+    assert stitched["complete"] and stitched["n_complete"] == 1
+    chain = stitched["best"]
+    assert chain["trace"] == "fedtr-v4"
+    assert chain["planes_crossed"] == ["client", "fed", "serve"]
+    assert len(chain["files"]) == 2  # the chain really crossed files
+    assert chain["upstream"][0]["train"]["name"] == "client.train"
+    assert chain["swap"]["name"] == "serve.swap"
+    assert chain["first_batch"]["name"] == "serve.batch"
+    summary = summarize(stitched)
+    assert summary["complete"] and summary["trace"] == "fedtr-v4"
+    assert summary["stages"] == [
+        "client.push", "client.train", "fed.flush", "serve.batch", "serve.swap",
+    ]
+    # A missing swap breaks completeness but never the stitch itself.
+    broken = stitch(load_records(_synthetic_chain_files(tmp_path, break_stage="serve.swap")))
+    assert not broken["complete"]
+    assert broken["best"]["first_batch"] is not None
+
+
+def test_stitch_cli_exit_codes(tmp_path, capsys):
+    from fedcrack_tpu.tools import trace_stitch
+
+    paths = _synthetic_chain_files(tmp_path)
+    out_json = str(tmp_path / "stitched.json")
+    rc = trace_stitch.main(
+        paths + ["--require", "client.push,fed.flush,serve.swap,serve.batch",
+                 "--json", out_json]
+    )
+    assert rc == 0
+    assert json.load(open(out_json))["n_complete"] == 1
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["complete"]
+    broken = _synthetic_chain_files(tmp_path, break_stage="serve.batch")
+    assert trace_stitch.main(broken) == 1  # default: demand a complete chain
