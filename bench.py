@@ -108,6 +108,9 @@ detail.chaos_recovery) FEDCRACK_BENCH_OUT=<full-payload artifact path>
 FEDCRACK_BENCH_SERVING=0 (skip the serving-plane section)
 FEDCRACK_BENCH_SERVE_SIZES=128,256 FEDCRACK_BENCH_SERVE_REQUESTS=128
 FEDCRACK_BENCH_SERVE_MAX_BATCH=8 FEDCRACK_BENCH_SERVE_CONCURRENCY=8
+FEDCRACK_BENCH_SERVE_FLEET=0 (skip the round-17 fleet/quant section)
+FEDCRACK_BENCH_FLEET_REPLICAS=1,2 FEDCRACK_BENCH_FLEET_REQUESTS=64
+FEDCRACK_BENCH_FLEET_SHED_RATE=40 (ramp-profile base rate, rps)
 FEDCRACK_BENCH_COMPRESSION=0 (skip the update-compression A/B)
 FEDCRACK_BENCH_COMPRESSION_ROUNDS=3 (mesh-twin trajectory rounds).
 FEDCRACK_BENCH_OBSERVABILITY=0 (skip the round-15 concurrent mini-soak)
@@ -165,6 +168,7 @@ DETAIL_SCHEMA: dict = {
     "input_pipeline": dict,
     "chaos_recovery": dict,
     "serving": dict,
+    "serve_fleet": dict,
     "update_compression": dict,
     "cohort_scale": dict,
     "async_federation": dict,
@@ -289,6 +293,33 @@ SERVING_SCHEMA: dict = {
     "swap": (dict, type(None)),
     "dropped": int,
 }
+# Typed keys of detail.serve_fleet (round 17): the fleet scale-out +
+# quantized-predict contract — the replicas x {bf16,int8} throughput/p95
+# grid, the fleet-wide two-phase swap (pause + zero torn versions), the
+# admission-control shed run under a ramp arrival profile, and the int8
+# install gate's verdict.
+SERVE_FLEET_SCHEMA: dict = {
+    "buckets": list,
+    "max_batch": int,
+    "grid": dict,
+    "swap": dict,
+    "shed": dict,
+    "quant_gate": (dict, type(None)),
+}
+# Per-arm keys of detail.serve_fleet.grid.*. `served_quant` records whether
+# the arm ACTUALLY served the quantized program (the grid's int8 fleets
+# install under a relaxed measurement floor; a false here on an int8 arm
+# means even that floor refused and the numbers are the bf16 fallback).
+SERVE_FLEET_ARM_SCHEMA: dict = {
+    "replicas": int,
+    "quant": str,
+    "served_quant": bool,
+    "requests": int,
+    "completed": int,
+    "throughput_rps": (int, float, type(None)),
+    "p50_ms": (int, float, type(None)),
+    "p95_ms": (int, float, type(None)),
+}
 # Per-point keys of detail.reference_scale.* and the per-arm dicts of
 # detail.segmented_pipeline.*: the staging/overlap decomposition contract.
 REF_POINT_SCHEMA: dict = {
@@ -329,6 +360,27 @@ def validate_detail(detail: dict) -> list:
                 bad.append(f"serving[{key!r}] missing")
             elif not isinstance(serving[key], typs):
                 bad.append(f"serving[{key!r}]: {type(serving[key]).__name__}")
+    fleet = detail.get("serve_fleet")
+    if isinstance(fleet, dict) and "error" not in fleet:
+        for key, typs in SERVE_FLEET_SCHEMA.items():
+            if key not in fleet:
+                bad.append(f"serve_fleet[{key!r}] missing")
+            elif not isinstance(fleet[key], typs):
+                bad.append(f"serve_fleet[{key!r}]: {type(fleet[key]).__name__}")
+        grid = fleet.get("grid")
+        for name, point in (grid if isinstance(grid, dict) else {}).items():
+            if not isinstance(point, dict):
+                # Report, never TypeError — the r12 wire-map contract.
+                bad.append(f"serve_fleet.grid[{name!r}]: {type(point).__name__}")
+                continue
+            for key, typs in SERVE_FLEET_ARM_SCHEMA.items():
+                if key not in point:
+                    bad.append(f"serve_fleet.grid[{name!r}][{key!r}] missing")
+                elif not isinstance(point[key], typs):
+                    bad.append(
+                        f"serve_fleet.grid[{name!r}][{key!r}]: "
+                        f"{type(point[key]).__name__}"
+                    )
     comp = detail.get("update_compression")
     if isinstance(comp, dict) and "error" not in comp:
         for key, typs in COMPRESSION_SCHEMA.items():
@@ -519,6 +571,20 @@ SERVE_SIZES = tuple(
 SERVE_REQUESTS = int(os.environ.get("FEDCRACK_BENCH_SERVE_REQUESTS", "128"))
 SERVE_MAX_BATCH = int(os.environ.get("FEDCRACK_BENCH_SERVE_MAX_BATCH", "8"))
 SERVE_CONCURRENCY = int(os.environ.get("FEDCRACK_BENCH_SERVE_CONCURRENCY", "8"))
+
+# Serve-fleet section (round 17, detail.serve_fleet): the replicas x
+# {bf16,int8} in-process router grid (throughput + p50/p95 per arm), a
+# fleet-wide two-phase swap with torn-version accounting, the gRPC-front-
+# door shed run under a load_gen ramp profile against a tight queue bound,
+# and the int8 install gate's probe-IoU verdict. "0" opts out.
+SERVE_FLEET = os.environ.get("FEDCRACK_BENCH_SERVE_FLEET", "1") == "1"
+FLEET_REPLICAS = tuple(
+    int(s)
+    for s in os.environ.get("FEDCRACK_BENCH_FLEET_REPLICAS", "1,2").split(",")
+    if s.strip()
+)
+FLEET_REQUESTS = int(os.environ.get("FEDCRACK_BENCH_FLEET_REQUESTS", "64"))
+FLEET_SHED_RATE = float(os.environ.get("FEDCRACK_BENCH_FLEET_SHED_RATE", "40"))
 
 # Longer-round multiplier for the dispatch-correction fit; the two-point
 # slope needs the rounds to differ, so 2 is the floor.
@@ -1896,6 +1962,223 @@ def _bench_serving(device) -> dict:
     }
 
 
+def _bench_serve_fleet(device) -> dict:
+    """Serve-fleet scale-out + quantized predict (round 17,
+    detail.serve_fleet).
+
+    Four measurements over one model:
+
+    - **grid**: replicas x {bf16,int8} closed-loop throughput and p50/p95
+      through the in-process router (the gRPC overhead is the r10 serving
+      section's number; this grid isolates the replica/quant levers).
+    - **swap**: a fleet-wide two-phase install under concurrent load —
+      commit pause (the fleet lock hold) and the torn-version count over
+      post-commit requests (the zero-torn claim, measured not assumed).
+    - **shed**: the full gRPC front door + load_gen ramp profile against a
+      tight queue bound — shed counts by reason and per-phase client
+      latency (admission control proven by overload, not by unit test).
+    - **quant_gate**: the int8 install gate's probe-IoU verdict (a refusal
+      is an honest artifact, not a failure: the fleet serves bf16 then).
+    """
+    import dataclasses
+    import threading
+
+    from fedcrack_tpu.configs import ModelConfig, ServeConfig
+    from fedcrack_tpu.models.resunet import init_variables
+    from fedcrack_tpu.obs.metrics import StreamingPercentiles
+    from fedcrack_tpu.serve import (
+        InferenceEngine,
+        ServeFleet,
+        ServeServer,
+        ServeServerThread,
+        ServeService,
+    )
+    from fedcrack_tpu.tools.load_gen import make_images, run_load
+
+    dtype = "bfloat16" if getattr(device, "platform", "") == "tpu" else "float32"
+    buckets = tuple(sorted(SERVE_SIZES))
+    base_cfg = ServeConfig(
+        bucket_sizes=buckets,
+        max_batch=SERVE_MAX_BATCH,
+        max_delay_ms=5.0,
+        tile_overlap=min(16, min(buckets) - 16) if min(buckets) > 16 else 0,
+        compute_dtype=dtype,
+        port=0,
+    )
+    model_config = ModelConfig(img_size=max(buckets), compute_dtype=dtype)
+    var_v0 = init_variables(jax.random.key(SEED), model_config)
+    var_v1 = init_variables(jax.random.key(SEED + 1), model_config)
+    images = make_images(FLEET_REQUESTS, buckets, SEED)
+
+    def drive(fleet, imgs, concurrency=SERVE_CONCURRENCY):
+        """Closed-loop router load: C threads, one request in flight each."""
+        from queue import Empty, Queue
+
+        jobs: Queue = Queue()
+        for img in imgs:
+            jobs.put(img)
+        versions: list[int] = []
+        vlock = threading.Lock()
+
+        def worker():
+            while True:
+                try:
+                    img = jobs.get_nowait()
+                except Empty:
+                    return
+                res = fleet.submit(img).result(timeout=300)
+                with vlock:
+                    versions.append(res.model_version)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0, versions
+
+    engines: dict[str, InferenceEngine] = {}
+    grid: dict[str, dict] = {}
+    for quant, arm in (("none", "bf16"), ("int8", "int8")):
+        cfg_q = dataclasses.replace(base_cfg, quant=quant)
+        engines[quant] = InferenceEngine(model_config, cfg_q)
+        if quant == "int8":
+            # The grid measures the int8 PROGRAM's throughput, so its
+            # fleets install under a relaxed MEASUREMENT floor; the
+            # production-floor verdict is the separate quant_gate record
+            # below (a refusal there is an honest artifact, but it must
+            # not silently turn the int8 arms into bf16 re-measurements).
+            cfg_q = dataclasses.replace(cfg_q, quant_iou_floor=0.5)
+        for n in FLEET_REPLICAS:
+            fleet = ServeFleet(
+                model_config,
+                dataclasses.replace(cfg_q, replicas=n),
+                var_v0,
+                shared_engine=engines[quant],
+            )
+            try:
+                from fedcrack_tpu.serve.quant import QuantizedVariables
+
+                served_quant = isinstance(
+                    fleet.manager.snapshot_for(0)[1], QuantizedVariables
+                )
+                wall, versions = drive(fleet, images)
+                pooled = StreamingPercentiles(8192)
+                for r in fleet.replicas:
+                    pooled.merge(r.batcher.latency)
+            finally:
+                fleet.close()
+            grid[f"r{n}_{arm}"] = {
+                "replicas": n,
+                "quant": arm,
+                "served_quant": served_quant,
+                "requests": len(images),
+                "completed": len(versions),
+                "wall_s": round(wall, 3),
+                "throughput_rps": round(len(versions) / wall, 3) if wall else None,
+                "p50_ms": pooled.percentile(50.0),
+                "p95_ms": pooled.percentile(95.0),
+            }
+
+    # The production-floor gate verdict (ServeConfig defaults): what an
+    # operator's install would do with THESE weights on THIS host.
+    from fedcrack_tpu.serve.quant import quant_gate as run_quant_gate
+    from fedcrack_tpu.serve.quant import quantize_variables
+
+    eng_q = engines["int8"]
+    quant_gate = run_quant_gate(
+        eng_q,
+        eng_q.prepare(var_v0),
+        eng_q.prepare_quantized(quantize_variables(var_v0)),
+    ).to_json()
+
+    # ---- fleet-wide two-phase swap under load (max replicas, int8 cfg:
+    # the swap re-runs the gate, so a refused quantization swaps bf16) ----
+    n_max = max(FLEET_REPLICAS)
+    swap_fleet = ServeFleet(
+        model_config,
+        dataclasses.replace(base_cfg, quant="int8", replicas=n_max),
+        var_v0,
+        shared_engine=engines["int8"],
+    )
+    try:
+        half = images[: max(1, len(images) // 2)]
+        _, pre_versions = drive(swap_fleet, half)
+        swap_fleet.install(1, var_v1)
+        _, post_versions = drive(swap_fleet, half)
+        torn = sum(1 for v in post_versions if v != 1)
+        swap = {
+            "replicas": n_max,
+            "pause_ms": (swap_fleet.manager.last_swap or {}).get("pause_ms"),
+            "prepare_ms": (swap_fleet.manager.last_swap or {}).get("load_ms"),
+            "pre_commit_versions": sorted(set(pre_versions)),
+            "post_commit_versions": sorted(set(post_versions)),
+            "torn_versions": torn,
+            "zero_torn": torn == 0,
+        }
+    finally:
+        swap_fleet.close()
+
+    # ---- admission control: gRPC front door + ramp arrival profile vs a
+    # tight queue bound — the 2x phase MUST shed, the artifact shows where ----
+    shed_cfg = dataclasses.replace(
+        base_cfg, quant="none", replicas=n_max, queue_bound=4
+    )
+    shed_fleet = ServeFleet(
+        model_config, shed_cfg, var_v0, shared_engine=engines["none"]
+    )
+    server = ServeServer(
+        ServeService(shed_fleet.engine, shed_fleet.router, shed_fleet.manager),
+        port=0,
+    )
+    try:
+        with ServeServerThread(server) as thread:
+            shed_summary = run_load(
+                f"127.0.0.1:{thread.port}",
+                mode="open",
+                profile="ramp",
+                n_requests=max(32, FLEET_REQUESTS),
+                rate_rps=FLEET_SHED_RATE,
+                concurrency=SERVE_CONCURRENCY,
+                sizes=(min(buckets),),
+                seed=SEED,
+            )
+    finally:
+        shed_fleet.close()
+    shed = {
+        "profile": "ramp",
+        "rate_rps": FLEET_SHED_RATE,
+        "queue_bound": shed_cfg.queue_bound,
+        "total": shed_summary["shed"],
+        "by_reason": shed_fleet.router.shed_counts(),
+        "completed": shed_summary["completed"],
+        "dropped": shed_summary["dropped"],
+        "per_phase": shed_summary["per_phase"],
+    }
+
+    return {
+        "dtype": dtype,
+        "buckets": list(buckets),
+        "max_batch": base_cfg.max_batch,
+        "concurrency": SERVE_CONCURRENCY,
+        "grid": grid,
+        "swap": swap,
+        "shed": shed,
+        "quant_gate": quant_gate,
+        "note": (
+            "in-process router grid isolates the replica/quant levers "
+            "(gRPC overhead is detail.serving's number); int8 grid arms "
+            "install under a relaxed measurement floor so they measure the "
+            "quantized PROGRAM (served_quant says what actually ran) while "
+            "quant_gate is the production-floor verdict; zero_torn is "
+            "measured over post-commit requests; CPU-smoke ratios are "
+            "machinery validation — decisive img/s queue behind the "
+            "ROADMAP TPU session"
+        ),
+    }
+
+
 def _bench_update_compression(rounds: int = COMPRESSION_ROUNDS) -> dict:
     """Compressed update transport A/B (round 12, fedcrack_tpu/compress).
 
@@ -2730,6 +3013,30 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
             _set_payload(metric_headline, value, vs_baseline, detail)
         else:
             _skip(skips, "serving", serve_est, "estimate exceeds remaining budget")
+
+    # ---- serve fleet (round 17): replicas x quant grid through the
+    # in-process router, the fleet-wide two-phase swap, and the ramp-profile
+    # shed run — this round's deliverable, right after the r10 serving
+    # section (they share warm programs when both run) ----
+    if SERVE_FLEET:
+        fleet_est = (
+            3 * COMPILE_EST_S  # ref + int8 + (cache-warm) swap/shed builds
+            + len(FLEET_REPLICAS) * 2 * FLEET_REQUESTS * 0.15
+            + 30.0
+        )
+        if _fits(fleet_est):
+            t0 = time.monotonic()
+            try:
+                detail["serve_fleet"] = _bench_serve_fleet(device)
+            except Exception as e:  # never kills the artifact
+                detail["serve_fleet"] = {"error": repr(e)}
+            section_s["serve_fleet"] = time.monotonic() - t0
+            detail["budget"] = _budget_detail()
+            _set_payload(metric_headline, value, vs_baseline, detail)
+        else:
+            _skip(
+                skips, "serve_fleet", fleet_est, "estimate exceeds remaining budget"
+            )
 
     # ---- layout A/B (round 6): the VERDICT r5 top ask — space-to-depth /
     # channel-packing graph transforms vs the reference layout, interleaved,

@@ -3,8 +3,9 @@
 - **OBS001 non-catalog metric name**: every ``registry.counter(...)`` /
   ``registry.gauge(...)`` / ``registry.histogram(...)`` call site must name
   its metric with a **string literal** that is ``snake_case`` and carries a
-  unit suffix (``_seconds``, ``_bytes``, ``_total``, ``_ratio``, or
-  ``_versions`` — the staleness unit). Two failure modes this kills:
+  unit suffix (``_seconds``, ``_bytes``, ``_total``, ``_ratio``,
+  ``_versions`` — the staleness unit — or ``_replicas``, the fleet
+  population unit). Two failure modes this kills:
 
   * a *computed* name (f-string, variable, concatenation) makes the metric
     catalog ungreppable — ``grep -r fed_updates_total`` must find every
@@ -42,7 +43,7 @@ from fedcrack_tpu.analysis.engine import Finding, ModuleSource, Rule, Severity
 
 METRIC_METHODS = ("counter", "gauge", "histogram")
 NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
-UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_ratio", "_versions")
+UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_ratio", "_versions", "_replicas")
 
 
 def _registry_receiver(call: ast.Call) -> bool:
@@ -75,8 +76,8 @@ class MetricCatalogNameRule(Rule):
     description = (
         "registry.counter/gauge/histogram metric name must be a snake_case "
         "string literal with a unit suffix (_seconds/_bytes/_total/_ratio/"
-        "_versions) — computed or free-spelled names break the greppable "
-        "catalog and the exposition's stability"
+        "_versions/_replicas) — computed or free-spelled names break the "
+        "greppable catalog and the exposition's stability"
     )
 
     def check(self, module: ModuleSource) -> Iterable[Finding]:

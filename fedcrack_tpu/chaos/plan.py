@@ -45,6 +45,15 @@ MESH_NONFINITE = "mesh_nonfinite"              # round output poisoned with NaNs
 SERVE_SWAP_MIDFLIGHT = "serve_swap_midflight"  # install a new model while a batch is in flight
 SERVE_DEVICE_LOSS = "serve_device_loss"        # batch dispatch raises (device loss)
 
+# Serve-fleet plane (round 17). Scenario-harness kind like the edge crash:
+# a "crashed" replica runs no hook, so tools/chaos_drill.run_replica_crash_drill
+# and tests/test_fleet.py consume this from the plan, call
+# FleetRouter.kill_replica mid-load (queued requests drain to survivors with
+# their original futures — zero accepted requests dropped), and then prove
+# the fleet-wide two-phase swap still lands on the survivors. `round` is the
+# replica index to kill.
+SERVE_REPLICA_CRASH = "serve_replica_crash"
+
 # Aggregation-tree plane (round 13). Like the server kill, a dead edge
 # process cannot run an in-process hook — this kind is consumed by the
 # scenario harnesses (tools/chaos_drill.run_edge_crash_drill,
@@ -85,7 +94,10 @@ SERVE_KINDS = frozenset({SERVE_SWAP_MIDFLIGHT, SERVE_DEVICE_LOSS})
 # no hook); `client` carries the edge id.
 TREE_KINDS = frozenset({EDGE_AGGREGATOR_CRASH})
 STORM_KINDS = frozenset({STRAGGLER_STORM})
-ALL_KINDS = CLIENT_KINDS | MESH_KINDS | SERVE_KINDS | TREE_KINDS | STORM_KINDS
+FLEET_KINDS = frozenset({SERVE_REPLICA_CRASH})
+ALL_KINDS = (
+    CLIENT_KINDS | MESH_KINDS | SERVE_KINDS | TREE_KINDS | STORM_KINDS | FLEET_KINDS
+)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -139,6 +139,33 @@ class ServeConfig:
     host: str = "127.0.0.1"
     port: int = 8890
     max_message_mb: int = 64
+    # ---- Serve fleet + quantized predict (round 17) ----
+    # Replica workers behind the fleet router (serve/fleet.py). 1 keeps the
+    # round-10 single-replica topology (no router, no admission control).
+    replicas: int = 1
+    # Post-training quantized predict program (serve/quant.py): "int8"
+    # builds a weight-only per-channel-symmetric int8 program per bucket
+    # alongside the reference program. Installs are A/B-gated: a quantized
+    # build whose probe-batch mask IoU vs the reference oracle falls below
+    # quant_iou_floor is REFUSED loudly and the replica keeps serving the
+    # unquantized program — never a silent accuracy cliff.
+    quant: str = "none"
+    quant_iou_floor: float = 0.98
+    # Optional activation fake-quant at the program boundary (dynamic
+    # per-tensor symmetric int8 of the pre-sigmoid logits). Weight-only
+    # quantization needs no calibration data; this flag measures the
+    # activation-quant accuracy headroom on top of it.
+    quant_act_fakequant: bool = False
+    # Seeded probe batch for the install-time A/B gate (per bucket size).
+    quant_probe_batch: int = 4
+    quant_probe_seed: int = 0
+    # Admission control (serve/router.py): shed load with a loud
+    # RESOURCE_EXHAUSTED reject when the fleet's rolling p95 latency
+    # breaches slo_p95_ms (0 = off) or when queued requests across all
+    # replicas exceed queue_bound (0 = off). Shedding happens at ACCEPT
+    # time only — a request already admitted is never dropped.
+    slo_p95_ms: float = 0.0
+    queue_bound: int = 0
 
     def __post_init__(self) -> None:
         if not self.bucket_sizes:
@@ -179,6 +206,24 @@ class ServeConfig:
                 "serve compute_dtype must be float32 or bfloat16, got "
                 f"{self.compute_dtype!r}"
             )
+        if self.replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {self.replicas}")
+        if self.quant not in ("none", "int8"):
+            raise ValueError(
+                f"serve quant must be 'none' or 'int8', got {self.quant!r}"
+            )
+        if not 0.0 < self.quant_iou_floor <= 1.0:
+            raise ValueError(
+                f"quant_iou_floor must be in (0, 1], got {self.quant_iou_floor}"
+            )
+        if self.quant_probe_batch < 1:
+            raise ValueError(
+                f"quant_probe_batch must be >= 1, got {self.quant_probe_batch}"
+            )
+        if self.slo_p95_ms < 0:
+            raise ValueError(f"slo_p95_ms must be >= 0, got {self.slo_p95_ms}")
+        if self.queue_bound < 0:
+            raise ValueError(f"queue_bound must be >= 0, got {self.queue_bound}")
 
 
 @dataclasses.dataclass(frozen=True)

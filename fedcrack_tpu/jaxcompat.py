@@ -164,3 +164,40 @@ def is_distributed_initialized() -> bool:
         return bool(fn())
     state = getattr(jax.distributed, "global_state", None)  # pragma: no cover
     return getattr(state, "client", None) is not None  # pragma: no cover
+
+
+def enable_compilation_cache(cache_dir: str) -> bool:
+    """Point JAX's persistent XLA compilation cache at ``cache_dir`` (the
+    round-17 cold-start killer: replica boots, CI sessions and repeat bench
+    runs reuse compiled programs instead of paying XLA again — BENCH_r03
+    died at rc 124 on exactly that wall).
+
+    The entry-size/compile-time floors are dropped to 0 so even the tiny
+    CPU-smoke programs cache (the knobs exist on 0.4.x under these names;
+    older builds without them still get the directory cache). Returns
+    whether the cache directory was accepted."""
+    import os
+
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception:  # pragma: no cover - ancient jax without the knob
+        return False
+    for knob, value in (
+        ("jax_persistent_cache_min_compile_time_secs", 0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(knob, value)
+        except Exception:  # pragma: no cover - knob renamed/missing
+            pass
+    # The cache singleton initializes lazily at the FIRST compile; a process
+    # that already compiled something (tests, a warm harness) latched it in
+    # the disabled state — reset so the new directory takes effect.
+    try:
+        from jax._src import compilation_cache
+
+        compilation_cache.reset_cache()
+    except Exception:  # pragma: no cover - internal API moved
+        pass
+    return True

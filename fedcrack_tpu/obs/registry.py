@@ -22,7 +22,8 @@ Design contracts:
   registries holding the same values expose byte-identical text.
 - **Catalog-stable names, enforced twice**: metric names must be
   ``snake_case`` with a unit suffix (``_seconds``, ``_bytes``, ``_total``,
-  ``_ratio``, or ``_versions`` for staleness) — validated here at runtime
+  ``_ratio``, ``_versions`` for staleness, or ``_replicas`` for fleet
+  population) — validated here at runtime
   and by the fedlint rule OBS001 statically, so the exposition a dashboard
   scrapes can never drift into free-form spelling.
 - **Get-or-create**: calling ``registry.counter(name, ...)`` twice returns
@@ -48,8 +49,10 @@ _LABEL_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
 
 # The unit vocabulary OBS001 pins (ISSUE r15): the issue's four suffixes
 # plus `_versions`, the async plane's staleness unit (a staleness histogram
-# measures model-version lag, not seconds or bytes).
-UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_ratio", "_versions")
+# measures model-version lag, not seconds or bytes), and `_replicas`
+# (round 17: the serve fleet's live-worker count — a population gauge,
+# not a monotone total).
+UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_ratio", "_versions", "_replicas")
 
 # Latency-shaped default buckets (Prometheus client defaults extended to
 # 30 s — a federation flush on a loaded CPU host can take seconds).

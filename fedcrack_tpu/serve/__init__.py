@@ -12,7 +12,13 @@ The subsystem that turns the training stack's outputs into the ROADMAP's
   the federation's checkpoint/statefile outputs, swapping served weights at
   a request-boundary barrier (serve-while-training);
 - :mod:`fedcrack_tpu.serve.service` — the gRPC ``ServePlane/Predict``
-  front door (``python -m fedcrack_tpu.serve``).
+  front door (``python -m fedcrack_tpu.serve``);
+- :mod:`fedcrack_tpu.serve.quant` — int8 weight-only post-training
+  quantized predict programs, A/B-gated on probe mask IoU vs the
+  reference oracle (round 17);
+- :mod:`fedcrack_tpu.serve.fleet` / :mod:`fedcrack_tpu.serve.router` —
+  the multi-replica fleet: least-outstanding routing, SLO load shedding,
+  fleet-wide two-phase coordinated hot swap (round 17).
 """
 
 from fedcrack_tpu.serve.batcher import (  # noqa: F401
@@ -26,6 +32,17 @@ from fedcrack_tpu.serve.hot_swap import (  # noqa: F401
     publish_statefile,
     read_statefile_weights,
 )
+from fedcrack_tpu.serve.fleet import (  # noqa: F401
+    FleetVersionManager,
+    Replica,
+    ServeFleet,
+)
+from fedcrack_tpu.serve.quant import (  # noqa: F401
+    QuantizedVariables,
+    quant_gate,
+    quantize_variables,
+)
+from fedcrack_tpu.serve.router import FleetRouter, LoadShedError  # noqa: F401
 from fedcrack_tpu.serve.service import (  # noqa: F401
     ServeServer,
     ServeServerThread,

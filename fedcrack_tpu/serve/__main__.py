@@ -37,6 +37,36 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sliding-window overlap px (must be < smallest bucket)")
     p.add_argument("--swap-poll-s", type=float)
     p.add_argument("--compute-dtype", choices=["float32", "bfloat16"])
+    p.add_argument(
+        "--replicas",
+        type=int,
+        help="replica workers behind the fleet router (>1 enables the "
+        "round-17 fleet: least-outstanding dispatch, coordinated two-phase "
+        "hot swap, admission control)",
+    )
+    p.add_argument(
+        "--quant",
+        choices=["none", "int8"],
+        help="post-training weight quantization of the predict program; "
+        "int8 installs are A/B-gated on probe mask IoU vs the reference "
+        "oracle and refused below --quant-iou-floor",
+    )
+    p.add_argument("--quant-iou-floor", type=float)
+    p.add_argument(
+        "--slo-p95-ms",
+        type=float,
+        help="shed (RESOURCE_EXHAUSTED) when rolling p95 breaches this; 0 off",
+    )
+    p.add_argument(
+        "--queue-bound",
+        type=int,
+        help="shed when queued requests across replicas reach this; 0 off",
+    )
+    p.add_argument(
+        "--compile-cache-dir",
+        help="persistent XLA compilation cache directory (warm replica "
+        "boots; jax_compilation_cache_dir)",
+    )
     p.add_argument("--metrics-path", help="JSONL metrics sink (serve_batch/serve_swap)")
     p.add_argument(
         "--metrics-port",
@@ -83,6 +113,16 @@ def resolve_config(args):
         overrides["host"] = args.host
     if args.port is not None:
         overrides["port"] = args.port
+    if args.replicas is not None:
+        overrides["replicas"] = args.replicas
+    if args.quant is not None:
+        overrides["quant"] = args.quant
+    if args.quant_iou_floor is not None:
+        overrides["quant_iou_floor"] = args.quant_iou_floor
+    if args.slo_p95_ms is not None:
+        overrides["slo_p95_ms"] = args.slo_p95_ms
+    if args.queue_bound is not None:
+        overrides["queue_bound"] = args.queue_bound
     if overrides:
         serve = dataclasses.replace(serve, **overrides)
     return fed.model, serve
@@ -128,6 +168,14 @@ async def _serve(args) -> int:
     from fedcrack_tpu.serve.hot_swap import ModelVersionManager
     from fedcrack_tpu.serve.service import ServeServer, ServeService
 
+    if args.compile_cache_dir:
+        # Warm boot (round 17): point the persistent XLA cache at the shared
+        # directory BEFORE any program compiles — the 2nd..Nth replica/
+        # session reuses the 1st one's executables.
+        from fedcrack_tpu.jaxcompat import enable_compilation_cache
+
+        enable_compilation_cache(args.compile_cache_dir)
+
     model_config, serve_config = resolve_config(args)
     template = init_variables(jax.random.key(args.seed), model_config)
     version, variables = resolve_initial_weights(args, template, args.seed)
@@ -138,18 +186,37 @@ async def _serve(args) -> int:
 
         metrics = MetricsLogger(args.metrics_path)
 
-    engine = InferenceEngine(model_config, serve_config)
-    manager = ModelVersionManager(
-        engine,
-        variables,
-        initial_version=version,
-        ckpt_dir=args.ckpt_dir,
-        state_path=args.state_path,
-        poll_s=serve_config.swap_poll_s,
-        template=template,
-        metrics=metrics,
-    )
-    engine.warmup(manager.snapshot()[1])
+    fleet = None
+    if serve_config.replicas > 1 or serve_config.quant != "none":
+        # Round-17 fleet topology (also the single-replica quantized shape:
+        # the fleet manager owns the A/B gate).
+        from fedcrack_tpu.serve.fleet import ServeFleet
+
+        fleet = ServeFleet(
+            model_config,
+            serve_config,
+            variables,
+            initial_version=version,
+            ckpt_dir=args.ckpt_dir,
+            state_path=args.state_path,
+            template=template,
+            metrics=metrics,
+        )
+        engine, batcher_like, manager = fleet.engine, fleet.router, fleet.manager
+    else:
+        engine = InferenceEngine(model_config, serve_config)
+        manager = ModelVersionManager(
+            engine,
+            variables,
+            initial_version=version,
+            ckpt_dir=args.ckpt_dir,
+            state_path=args.state_path,
+            poll_s=serve_config.swap_poll_s,
+            template=template,
+            metrics=metrics,
+        )
+        engine.warmup(manager.snapshot()[1])
+        batcher_like = MicroBatcher(engine, manager, metrics=metrics)
     # Live telemetry (round 15): /metrics exporter + post-warmup recompile
     # sentry (serve_recompiles_total must stay 0 across hot swaps) + spans.
     from fedcrack_tpu.obs.promexp import start_exporter
@@ -161,9 +228,8 @@ async def _serve(args) -> int:
         from fedcrack_tpu.obs import spans as tracing
 
         tracing.install(args.spans_path)
-    batcher = MicroBatcher(engine, manager, metrics=metrics)
     server = ServeServer(
-        ServeService(engine, batcher, manager),
+        ServeService(engine, batcher_like, manager),
         host=serve_config.host,
         port=serve_config.port,
         max_message_mb=serve_config.max_message_mb,
@@ -177,6 +243,7 @@ async def _serve(args) -> int:
         f"SERVING {serve_config.host}:{port} "
         f"buckets={','.join(str(s) for s in serve_config.bucket_sizes)} "
         f"max_batch={serve_config.max_batch} version={manager.version}"
+        f" replicas={serve_config.replicas} quant={serve_config.quant}"
         f"{metrics_note}",
         flush=True,
     )
@@ -190,14 +257,18 @@ async def _serve(args) -> int:
             pass
     await stop.wait()
     await server.stop()
-    manager.stop()
-    batcher.close()
+    if fleet is not None:
+        fleet.close()
+    else:
+        manager.stop()
+        batcher_like.close()
     if exporter is not None:
         exporter.stop()
     if metrics is not None:
         import json
 
-        print(json.dumps({"serve_stats": batcher.stats()}), flush=True)
+        stats = fleet.stats() if fleet is not None else batcher_like.stats()
+        print(json.dumps({"serve_stats": stats}), flush=True)
         metrics.close()
     return 0
 

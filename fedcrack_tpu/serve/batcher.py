@@ -96,9 +96,14 @@ class MicroBatcher:
         metrics: Any | None = None,
         chaos: Any | None = None,
         reservoir_capacity: int = 4096,
+        replica: int | None = None,
     ):
         self.engine = engine
         self.weights = weights
+        # Fleet identity (round 17): stamped on every serve.batch span so a
+        # stitched trace shows WHICH replica served a request; None keeps
+        # the single-replica span shape byte-identical to round 10.
+        self.replica = replica
         self.max_batch = engine.max_batch
         cfg_delay = engine.serve_config.max_delay_ms
         self.max_delay_s = (
@@ -165,6 +170,10 @@ class MicroBatcher:
         self._linked_versions: set[int] = set()
         self.swap_gaps_ms: list[float] = []
         self._running = True
+        # drain() halt: unlike close() (which lets workers empty their
+        # queues), a draining replica must stop PROMPTLY so queued requests
+        # can move to survivors — only the in-flight batch finishes.
+        self._halt = False
         self._workers = [
             threading.Thread(target=self._worker, args=(size,), daemon=True)
             for size in engine.bucket_sizes
@@ -184,8 +193,6 @@ class MicroBatcher:
                 f"got {image_u8.shape} (route through the front door for "
                 f"padding/tiling)"
             )
-        if not self._running:
-            raise RuntimeError("batcher is closed")
         now = time.monotonic()
         cfg_deadline = self.engine.serve_config.deadline_ms
         if deadline_ms is None and cfg_deadline > 0:
@@ -195,10 +202,17 @@ class MicroBatcher:
             t_submit=now,
             deadline_s=(now + deadline_ms / 1e3) if deadline_ms else None,
         )
+        # The running check and the enqueue share one locked section, and
+        # drain() flips the halt flags under the same lock — so a request
+        # either lands in the queue BEFORE a drain begins (the sweep
+        # reroutes it) or sees the closed batcher and raises; it can never
+        # slip into a halted queue after the sweep and hang its Future.
         with self._lock:
+            if not self._running:
+                raise RuntimeError("batcher is closed")
             self._counts["submitted"] += 1
             req.trace = f"req-{self._counts['submitted']:06d}"
-        self._queues[h].put(req)
+            self._queues[h].put(req)
         self._m_qdepth.set(sum(q.qsize() for q in self._queues.values()))
         return req.future
 
@@ -209,6 +223,8 @@ class MicroBatcher:
         delay window closes. None = shutdown."""
         q = self._queues[size]
         while True:
+            if self._halt:
+                return None
             try:
                 first = q.get(timeout=0.05)
                 break
@@ -218,6 +234,8 @@ class MicroBatcher:
         batch = [first]
         t_close = time.monotonic() + self.max_delay_s
         while len(batch) < self.max_batch:
+            if self._halt:
+                break  # dispatch what we hold; the queue moves to survivors
             remaining = t_close - time.monotonic()
             if remaining <= 0:
                 break
@@ -272,6 +290,8 @@ class MicroBatcher:
                             "trace": parsed.trace,
                             "remote_parent": wire,
                         }
+            if self.replica is not None:
+                span_route["replica"] = self.replica
             try:
                 # One span per dispatched batch, joined to its requests by
                 # their req-NNNNNN correlation ids and to the swap plane by
@@ -364,6 +384,57 @@ class MicroBatcher:
                 model_version=version,
                 exec_ms=round((t1 - t0) * 1e3, 3),
             )
+
+    # ---- fleet plumbing (round 17) ----
+
+    def outstanding(self) -> int:
+        """Requests accepted but not yet answered (queued + in a batch) —
+        the router's least-outstanding dispatch key. O(lock)."""
+        with self._lock:
+            c = self._counts
+            return c["submitted"] - c["completed"] - c["failed"]
+
+    def queued(self) -> int:
+        """Requests waiting in bucket queues (not yet in a batch)."""
+        return sum(q.qsize() for q in self._queues.values())
+
+    def resubmit(self, req: _Request) -> None:
+        """Re-enqueue a request object drained from ANOTHER batcher — the
+        router's replica-failover path. The request keeps its submit time,
+        deadline and Future, so the original caller's handle resolves and
+        client-side latency accounting spans the failover."""
+        size = req.image.shape[0]
+        if size not in self._queues:
+            raise ValueError(
+                f"resubmit bucket {size} not served here ({self.engine.bucket_sizes})"
+            )
+        with self._lock:  # same check-and-enqueue atomicity as submit()
+            if not self._running:
+                raise RuntimeError("batcher is closed")
+            self._counts["submitted"] += 1
+            self._queues[size].put(req)
+        self._m_qdepth.set(sum(q.qsize() for q in self._queues.values()))
+
+    def drain(self) -> list[_Request]:
+        """Stop this replica and hand back everything still queued, futures
+        UNANSWERED (unlike :meth:`close`, which fails them) — the router
+        resubmits them to surviving replicas, so an accepted request rides a
+        replica crash instead of erroring. In-flight batches finish on this
+        replica first (their snapshot was already taken)."""
+        with self._lock:  # serialize vs submit(): see the enqueue comment
+            self._halt = True
+            self._running = False
+        for t in self._workers:
+            t.join(timeout=10)
+        leftovers: list[_Request] = []
+        for q in self._queues.values():
+            while True:
+                try:
+                    leftovers.append(q.get_nowait())
+                except queue.Empty:
+                    break
+        self._m_qdepth.set(0)
+        return leftovers
 
     # ---- observability / shutdown ----
 

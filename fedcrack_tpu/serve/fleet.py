@@ -1,0 +1,442 @@
+"""Multi-replica serve fleet with fleet-wide coordinated hot swap (round 17).
+
+Scales the r10 serve plane out: N :class:`Replica` workers (each an engine +
+micro-batcher) behind a :class:`~fedcrack_tpu.serve.router.FleetRouter`, with
+ONE :class:`FleetVersionManager` owning every replica's weights snapshot.
+
+**Two-phase swap — "zero torn versions fleet-wide".** A publish (statefile /
+checkpoint / direct install) runs:
+
+1. *Prepare* (off the serving path, no locks): host weights are device-placed
+   for every live replica's engine; with ``ServeConfig.quant="int8"`` the
+   int8 weight-only quantized payload is built and **A/B-gated** against the
+   reference program on a seeded probe batch (``serve/quant.py``) — a gate
+   failure REFUSES the quantized payload loudly and prepares the reference
+   payload instead (the replica keeps serving unquantized weights; never a
+   silent accuracy cliff).
+2. *Commit* (one fleet-lock acquisition): every replica's
+   ``(version, payload)`` slot flips together. The batcher's request-boundary
+   snapshot reads take the same lock, so a request accepted after commit
+   returns — on ANY replica — answers from the new version, and a batch that
+   snapshotted before the commit answers entirely from its snapshot (the
+   straddle contract, test-pinned exactly like the r10 single-process swap).
+   The lock-hold time is the fleet-wide pause, exported as
+   ``serve_fleet_swap_pause_seconds``.
+
+The manager wraps the r10 machinery rather than reimplementing it: source
+watching is the shared :class:`~fedcrack_tpu.serve.hot_swap.WeightSourceWatcher`,
+swap spans join the same version-lineage traces
+(``fedtr-v(N-1)#flush:vN``), and ``swap_context`` feeds the batcher's
+first-batch-on-version trace link per replica.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any
+
+from fedcrack_tpu.analysis.sanitizers import make_lock
+from fedcrack_tpu.obs import spans as tracing
+from fedcrack_tpu.obs.registry import REGISTRY
+from fedcrack_tpu.serve.batcher import MicroBatcher
+from fedcrack_tpu.serve.engine import InferenceEngine
+from fedcrack_tpu.serve.hot_swap import WeightSourceWatcher
+from fedcrack_tpu.serve.router import FleetRouter
+
+log = logging.getLogger("fedcrack.serve.fleet")
+
+
+class _ReplicaWeights:
+    """The batcher-facing weights source of one replica: snapshot() reads
+    the FLEET manager's slot for this replica (the commit barrier's lock),
+    swap_context() forwards the fleet swap's trace context."""
+
+    def __init__(self, manager: "FleetVersionManager", index: int):
+        self._manager = manager
+        self._index = index
+
+    def snapshot(self) -> tuple[int, Any]:
+        return self._manager.snapshot_for(self._index)
+
+    def swap_context(self, version: int) -> str | None:
+        return self._manager.swap_context(version)
+
+
+class Replica:
+    """One serve worker: an engine + a micro-batcher over the fleet slot.
+
+    ``engine`` may be shared across replicas (in-process fleets: one XLA
+    program, N serving lanes) or per-replica (the process-per-replica
+    deployment shape; the persistent compilation cache makes the 2nd..Nth
+    boot warm)."""
+
+    def __init__(
+        self,
+        index: int,
+        engine: InferenceEngine,
+        manager: "FleetVersionManager",
+        *,
+        metrics: Any | None = None,
+        chaos: Any | None = None,
+    ):
+        self.index = index
+        self.engine = engine
+        self.alive = True
+        self.batcher = MicroBatcher(
+            engine,
+            _ReplicaWeights(manager, index),
+            metrics=metrics,
+            chaos=chaos,
+            replica=index,
+        )
+
+
+class FleetVersionManager:
+    """Fleet-wide weights ownership: one slot per replica, flipped together.
+
+    The fleet analog of the r10 ``ModelVersionManager`` — same polling
+    sources (via the shared :class:`WeightSourceWatcher`), same off-path
+    heavy lifting, but ``install`` runs the two-phase prepare/commit over
+    every live replica. Replicas are registered AFTER construction
+    (:meth:`attach_replicas`) because batchers need the manager first.
+    """
+
+    def __init__(
+        self,
+        serve_config: Any,
+        *,
+        ckpt_dir: str | None = None,
+        state_path: str | None = None,
+        poll_s: float | None = None,
+        template: Any | None = None,
+        metrics: Any | None = None,
+    ):
+        self.serve_config = serve_config
+        self._watcher = WeightSourceWatcher(
+            ckpt_dir=ckpt_dir, state_path=state_path, template=template
+        )
+        self._poll_s = poll_s if poll_s is not None else serve_config.swap_poll_s
+        self._metrics = metrics
+        self._lock = make_lock("serve.fleet.snapshot")
+        self._replicas: list[Replica] = []
+        self._slots: list[tuple[int, Any]] = []
+        self._version = -1
+        self._swap_ctx: dict[int, str] = {}
+        self.swaps: list[dict] = []
+        self.last_swap: dict | None = None
+        self.quant_gates: list[dict] = []
+        self.last_quant_gate: dict | None = None
+        self._m_pause = REGISTRY.histogram(
+            "serve_fleet_swap_pause_seconds",
+            "commit-barrier hold of a fleet-wide swap (all replica pointers "
+            "flip under one lock; prepare/gate work happens off-path before)",
+        )
+        self._m_quant_iou = REGISTRY.gauge(
+            "serve_quant_iou_ratio",
+            "probe-batch mask IoU of the int8 predict program vs the "
+            "reference oracle at the last install gate (min over buckets; "
+            "installs below ServeConfig.quant_iou_floor are refused)",
+        )
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # ---- wiring ----
+
+    def attach_replicas(
+        self, replicas: list, initial_variables: Any, initial_version: int = 0
+    ) -> None:
+        """Register the fleet and install the initial weights on every
+        replica (prepare + commit, including the quant gate) — the boot-time
+        install, before any traffic."""
+        if self._replicas:
+            raise RuntimeError("replicas already attached")
+        self._replicas = list(replicas)
+        self._slots = [(-1, None)] * len(replicas)
+        payloads, _ = self._prepare_payloads(initial_variables)
+        with self._lock:
+            self._version = int(initial_version)
+            self._slots = [(int(initial_version), p) for p in payloads]
+
+    # ---- serving-path reads ----
+
+    def snapshot_for(self, index: int) -> tuple[int, Any]:
+        with self._lock:
+            return self._slots[index]
+
+    def snapshot(self) -> tuple[int, Any]:
+        """The front door's tiled-path read: replica 0's slot (tiled
+        requests run on replica 0's engine)."""
+        return self.snapshot_for(0)
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def swap_context(self, version: int) -> str | None:
+        with self._lock:
+            return self._swap_ctx.get(int(version))
+
+    # ---- the two-phase install ----
+
+    def _prepare_payloads(self, host_variables: Any):
+        """Phase 1: per-replica device payloads, quant-gated when enabled.
+        Runs WITHOUT the fleet lock — serving continues on current slots.
+        Returns (payloads, gate_record_or_None); a refused gate means every
+        payload is the reference program's weights."""
+        from fedcrack_tpu.serve import quant as quant_mod
+
+        engines: dict[int, Any] = {}
+        for r in self._replicas:
+            engines.setdefault(id(r.engine), r.engine)
+        ref_by_engine = {
+            eid: eng.prepare(host_variables) for eid, eng in engines.items()
+        }
+        gate_record = None
+        quant_by_engine: dict[int, Any] = {}
+        if self.serve_config.quant == "int8":
+            qhost = quant_mod.quantize_variables(host_variables)
+            quant_by_engine = {
+                eid: eng.prepare_quantized(qhost) for eid, eng in engines.items()
+            }
+            # Gate once per install on the first engine: quantization and
+            # the probe are deterministic, so every engine would return the
+            # same verdict; the per-engine PAYLOADS above are still placed
+            # separately (each engine owns its device buffers).
+            eid0, eng0 = next(iter(engines.items()))
+            # Gate knobs come from the FLEET's serve_config, not the
+            # engine's — a shared engine may have been built under a
+            # different floor than this fleet runs with.
+            gate = quant_mod.quant_gate(
+                eng0,
+                ref_by_engine[eid0],
+                quant_by_engine[eid0],
+                floor=self.serve_config.quant_iou_floor,
+                probe_batch=self.serve_config.quant_probe_batch,
+                probe_seed=self.serve_config.quant_probe_seed,
+            )
+            gate_record = gate.to_json()
+            self._m_quant_iou.set(gate.iou)
+            self.quant_gates.append(gate_record)
+            self.last_quant_gate = gate_record
+            if not gate.passed:
+                log.error(
+                    "int8 quantized build REFUSED: probe mask IoU %.4f < "
+                    "floor %.4f — fleet keeps serving the reference program",
+                    gate.iou,
+                    gate.floor,
+                )
+                quant_by_engine = {}
+            from fedcrack_tpu.obs import flight
+
+            flight.note(
+                "serve.quant_gate", passed=gate.passed, iou=gate.iou,
+                floor=gate.floor,
+            )
+        payloads = []
+        for r in self._replicas:
+            if not r.alive:
+                payloads.append(None)
+            elif quant_by_engine:
+                payloads.append(quant_by_engine[id(r.engine)])
+            else:
+                payloads.append(ref_by_engine[id(r.engine)])
+        return payloads, gate_record
+
+    def install(self, version: int, host_variables: Any) -> bool:
+        """Two-phase fleet swap to ``version`` (no-op unless strictly
+        newer). Prepare runs off-path; commit is one lock acquisition
+        flipping every live replica's slot — the barrier after which no
+        snapshot anywhere in the fleet returns the old version."""
+        current = self.version
+        if version <= current:
+            return False
+        fctx = tracing.flush_context(version)
+        sctx = tracing.TraceContext(fctx.trace, f"fleet-swap:v{version}")
+        with tracing.span(
+            "serve.fleet_swap",
+            trace=fctx.trace,
+            ctx=sctx.to_wire(),
+            remote_parent=fctx.to_wire(),
+            from_version=current,
+            to_version=version,
+            replicas=len(self._replicas),
+        ) as span_handle:
+            t0 = time.monotonic()
+            payloads, gate_record = self._prepare_payloads(host_variables)
+            load_ms = (time.monotonic() - t0) * 1e3
+            t_commit = time.monotonic()
+            with self._lock:
+                if version <= self._version:
+                    if span_handle is not None:
+                        span_handle.set(installed=False)
+                    return False
+                for i, payload in enumerate(payloads):
+                    if payload is not None:
+                        self._slots[i] = (version, payload)
+                self._version = version
+                self._swap_ctx[version] = sctx.to_wire()
+                while len(self._swap_ctx) > 8:
+                    self._swap_ctx.pop(min(self._swap_ctx))
+            pause_s = time.monotonic() - t_commit
+            if span_handle is not None:
+                span_handle.set(installed=True, pause_ms=round(pause_s * 1e3, 3))
+        self._m_pause.observe(pause_s)
+        REGISTRY.counter(
+            "serve_swaps_total", "hot swaps installed by the version manager"
+        ).inc()
+        from fedcrack_tpu.obs import flight
+
+        flight.note(
+            "serve.fleet_swap", from_version=current, to_version=version,
+            load_ms=round(load_ms, 3), pause_ms=round(pause_s * 1e3, 3),
+        )
+        record = {
+            "from_version": current,
+            "to_version": version,
+            "load_ms": round(load_ms, 3),
+            "pause_ms": round(pause_s * 1e3, 3),
+            "replicas": sum(1 for p in payloads if p is not None),
+            "quant_gate": gate_record,
+            # fedlint: disable=DET001 -- human-readable record timestamp
+            "ts": time.time(),
+        }
+        self.swaps.append(record)
+        self.last_swap = record
+        log.info(
+            "fleet hot-swap: v%d -> v%d on %d replicas (%.1f ms prepare, "
+            "%.3f ms commit pause)",
+            current, version, record["replicas"], load_ms, pause_s * 1e3,
+        )
+        if self._metrics is not None:
+            self._metrics.log("serve_fleet_swap", **record)
+        return True
+
+    # ---- polling lifecycle (same shape as the r10 manager) ----
+
+    def poll_once(self) -> bool:
+        got = self._watcher.best_available(self.version)
+        if got is None:
+            return False
+        return self.install(*got)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self._poll_s):
+                try:
+                    self.poll_once()
+                except Exception:
+                    log.exception("fleet swap poll failed; retrying next period")
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=10)
+            self._thread = None
+        self._watcher.close()
+
+
+class ServeFleet:
+    """The assembled fleet: engines, replicas, manager, router — what the
+    gRPC front door and the harnesses hold. ``submit``/``snapshot`` mirror
+    the single-replica batcher/manager surface, so ``ServeService`` works
+    unchanged."""
+
+    def __init__(
+        self,
+        model_config: Any,
+        serve_config: Any,
+        initial_variables: Any,
+        *,
+        initial_version: int = 0,
+        ckpt_dir: str | None = None,
+        state_path: str | None = None,
+        template: Any | None = None,
+        metrics: Any | None = None,
+        chaos: Any | None = None,
+        shared_engine: InferenceEngine | None = None,
+        share_engine: bool = True,
+        router_window_s: float = 10.0,
+        warmup: bool = True,
+    ):
+        n = serve_config.replicas
+        if shared_engine is not None:
+            engines = [shared_engine] * n
+        elif share_engine:
+            engines = [InferenceEngine(model_config, serve_config)] * n
+        else:
+            engines = [InferenceEngine(model_config, serve_config) for _ in range(n)]
+        self.manager = FleetVersionManager(
+            serve_config,
+            ckpt_dir=ckpt_dir,
+            state_path=state_path,
+            template=template,
+            metrics=metrics,
+        )
+        self.replicas = [
+            Replica(i, engines[i], self.manager, metrics=metrics, chaos=chaos)
+            for i in range(n)
+        ]
+        self.manager.attach_replicas(
+            self.replicas, initial_variables, initial_version
+        )
+        if warmup:
+            from fedcrack_tpu.serve.quant import QuantizedVariables, quantize_variables
+
+            seen: set[int] = set()
+            for r in self.replicas:
+                if id(r.engine) in seen:
+                    continue
+                seen.add(id(r.engine))
+                _, payload = self.manager.snapshot_for(r.index)
+                r.engine.warmup(payload)
+                if serve_config.quant == "int8":
+                    # Warm BOTH programs: a refused gate serves the
+                    # reference program, a later passing install swaps to
+                    # the quantized one — neither may pay compile mid-traffic.
+                    if isinstance(payload, QuantizedVariables):
+                        r.engine.warmup(r.engine.prepare(initial_variables))
+                    else:
+                        r.engine.warmup(
+                            r.engine.prepare_quantized(
+                                quantize_variables(initial_variables)
+                            )
+                        )
+        self.router = FleetRouter(
+            self.replicas, serve_config, window_s=router_window_s
+        )
+
+    # batcher-shaped surface for the front door
+    def submit(self, image_u8, deadline_ms=None):
+        return self.router.submit(image_u8, deadline_ms=deadline_ms)
+
+    def snapshot(self):
+        return self.manager.snapshot()
+
+    @property
+    def engine(self) -> InferenceEngine:
+        return self.replicas[0].engine
+
+    def install(self, version: int, host_variables: Any) -> bool:
+        return self.manager.install(version, host_variables)
+
+    def stats(self) -> dict:
+        return {
+            "router": self.router.stats(),
+            "swaps": list(self.manager.swaps),
+            "quant_gate": self.manager.last_quant_gate,
+        }
+
+    def close(self) -> None:
+        self.manager.stop()
+        for r in self.replicas:
+            r.batcher.close()

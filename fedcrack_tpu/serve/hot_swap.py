@@ -103,6 +103,77 @@ def publish_statefile(
     atomic_write_bytes(path, msgpack.packb(payload, use_bin_type=True))
 
 
+class WeightSourceWatcher:
+    """The federation-output watcher shared by the single-process
+    :class:`ModelVersionManager` and the fleet-wide
+    ``serve.fleet.FleetVersionManager`` (round 17 refactor): knows where new
+    global models come from (statefile and/or orbax checkpoint dir), which
+    one currently wins (highest version), and how to read them — nothing
+    about serving. Corrupt/unreadable sources are logged and skipped; the
+    caller keeps its current model."""
+
+    def __init__(
+        self,
+        *,
+        ckpt_dir: str | None = None,
+        state_path: str | None = None,
+        template: Any | None = None,
+    ):
+        self._ckpt_dir = ckpt_dir or None
+        self._state_path = state_path or None
+        self._template = template
+        self._ckptr = None
+
+    def _checkpointer(self):
+        from fedcrack_tpu.ckpt.manager import FedCheckpointer
+
+        if self._ckptr is None:
+            self._ckptr = FedCheckpointer(self._ckpt_dir)
+        else:
+            # orbax caches the step listing; newer managers expose reload().
+            reload = getattr(self._ckptr._mngr, "reload", None)
+            if callable(reload):
+                try:
+                    reload()
+                except Exception:
+                    pass
+        return self._ckptr
+
+    def best_available(self, newer_than: int):
+        """Highest-version (version, host_variables) across sources that
+        beats ``newer_than``; None when nothing newer exists."""
+        best = None
+        if self._state_path and os.path.exists(self._state_path):
+            got = read_statefile_weights(self._state_path, template=self._template)
+            if got is not None and got[0] > newer_than:
+                best = got
+        if self._ckpt_dir and os.path.isdir(self._ckpt_dir):
+            try:
+                ckptr = self._checkpointer()
+                latest = ckptr.latest_version()
+            except Exception:
+                log.exception("checkpoint dir %s unreadable; skipping", self._ckpt_dir)
+                latest = None
+            if latest is not None and latest > newer_than and (
+                best is None or latest > best[0]
+            ):
+                try:
+                    ckpt = ckptr.restore(self._template)
+                    if ckpt is not None:
+                        best = (int(ckpt.model_version), ckpt.variables)
+                except Exception:
+                    log.exception("checkpoint restore failed; keeping current model")
+        return best
+
+    def close(self) -> None:
+        if self._ckptr is not None:
+            try:
+                self._ckptr.close()
+            except Exception:
+                pass
+            self._ckptr = None
+
+
 class ModelVersionManager:
     """Watches federation outputs and owns the served weights snapshot.
 
@@ -125,14 +196,13 @@ class ModelVersionManager:
         metrics: Any | None = None,
     ):
         self.engine = engine
-        self._ckpt_dir = ckpt_dir or None
-        self._state_path = state_path or None
+        self._watcher = WeightSourceWatcher(
+            ckpt_dir=ckpt_dir, state_path=state_path, template=template
+        )
         self._poll_s = poll_s
-        self._template = template
         self._metrics = metrics
         self._lock = make_lock("serve.hot_swap.snapshot")
         self._current = (int(initial_version), engine.prepare(initial_variables))
-        self._ckptr = None
         # Swap wire contexts by installed version (round 16): the batcher
         # links the FIRST batch served on a version to its swap span via
         # swap_context(). Bounded — only recent versions matter.
@@ -161,53 +231,12 @@ class ModelVersionManager:
 
     # ---- polling ----
 
-    def _checkpointer(self):
-        from fedcrack_tpu.ckpt.manager import FedCheckpointer
-
-        if self._ckptr is None:
-            self._ckptr = FedCheckpointer(self._ckpt_dir)
-        else:
-            # orbax caches the step listing; newer managers expose reload().
-            reload = getattr(self._ckptr._mngr, "reload", None)
-            if callable(reload):
-                try:
-                    reload()
-                except Exception:
-                    pass
-        return self._ckptr
-
-    def _best_available(self, newer_than: int):
-        """Highest-version (version, host_variables) across sources that
-        beats ``newer_than``; None when nothing newer exists."""
-        best = None
-        if self._state_path and os.path.exists(self._state_path):
-            got = read_statefile_weights(self._state_path, template=self._template)
-            if got is not None and got[0] > newer_than:
-                best = got
-        if self._ckpt_dir and os.path.isdir(self._ckpt_dir):
-            try:
-                ckptr = self._checkpointer()
-                latest = ckptr.latest_version()
-            except Exception:
-                log.exception("checkpoint dir %s unreadable; skipping", self._ckpt_dir)
-                latest = None
-            if latest is not None and latest > newer_than and (
-                best is None or latest > best[0]
-            ):
-                try:
-                    ckpt = ckptr.restore(self._template)
-                    if ckpt is not None:
-                        best = (int(ckpt.model_version), ckpt.variables)
-                except Exception:
-                    log.exception("checkpoint restore failed; keeping current model")
-        return best
-
     def poll_once(self) -> bool:
         """Check sources; install a newer model if one exists. Returns
         whether a swap happened. Heavy work (decode + device transfer) runs
         here, outside the snapshot lock."""
         current_version, _ = self.snapshot()
-        got = self._best_available(current_version)
+        got = self._watcher.best_available(current_version)
         if got is None:
             return False
         return self.install(*got)
@@ -314,12 +343,7 @@ class ModelVersionManager:
         self._stop.set()
         self._thread.join(timeout=10)
         self._thread = None
-        if self._ckptr is not None:
-            try:
-                self._ckptr.close()
-            except Exception:
-                pass
-            self._ckptr = None
+        self._watcher.close()
 
     def __enter__(self) -> "ModelVersionManager":
         self.start()

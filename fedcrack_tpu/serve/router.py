@@ -1,0 +1,288 @@
+"""Fleet router: least-outstanding-requests dispatch + SLO load shedding.
+
+The round-17 front of the multi-replica serve fleet (serve/fleet.py). One
+router owns N replicas (each an engine + micro-batcher); ``submit`` is the
+single admission point:
+
+1. **Admission control** — before a request is accepted it may be SHED with
+   a loud :class:`LoadShedError` (the gRPC front door answers
+   ``RESOURCE_EXHAUSTED``): when queued requests across live replicas exceed
+   ``ServeConfig.queue_bound``, or when the fleet's rolling p95 latency
+   breaches ``ServeConfig.slo_p95_ms``. Shedding happens ONLY here — a
+   request that was admitted is never dropped, whatever fails afterwards
+   (the zero-drop discipline the r10 plane pins, now fleet-wide).
+2. **Dispatch** — the live replica with the fewest outstanding requests
+   wins (ties break to the lowest replica index — deterministic routing for
+   a deterministic test plane). A ``serve.route`` span records the choice
+   so stitched traces show which replica served a request.
+
+Rolling p95: per-completion latencies feed a pair of bounded reservoirs
+(:class:`fedcrack_tpu.obs.metrics.StreamingPercentiles`) rotated every
+``window_s`` — reads pool the current and previous window, so the probe
+tracks the last ~1-2 windows instead of the whole run (a breach recovers
+once latencies do; an all-run reservoir would hold the SLO breached
+forever). The probe arms only past ``MIN_SHED_SAMPLES`` completions per
+window pair, so one slow cold request cannot shed.
+
+Replica failure: :meth:`kill_replica` (the chaos drill's crash hook, and
+the operational remove path) drains the dead replica's queued requests and
+resubmits them — with their original futures and submit times — to
+survivors, bypassing admission control: they were already accepted.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any
+
+import numpy as np
+
+from fedcrack_tpu.analysis.sanitizers import make_lock
+from fedcrack_tpu.obs import spans as tracing
+from fedcrack_tpu.obs.metrics import StreamingPercentiles
+from fedcrack_tpu.obs.registry import REGISTRY
+
+# The p95 shed probe stays disarmed until this many samples sit in the
+# rolling window pair — shedding on a cold-start sample would page on noise.
+MIN_SHED_SAMPLES = 16
+
+SHED_QUEUE_BOUND = "queue_bound"
+SHED_P95_SLO = "p95_slo"
+
+
+class LoadShedError(RuntimeError):
+    """Admission refused — the caller gets this BEFORE the request enters
+    any queue (RESOURCE_EXHAUSTED at the front door, never a silent drop)."""
+
+    def __init__(self, reason: str, detail: str):
+        super().__init__(f"load shed ({reason}): {detail}")
+        self.reason = reason
+        self.detail = detail
+
+
+class RollingPercentiles:
+    """Two-reservoir rolling latency window: samples land in the current
+    reservoir; every ``window_s`` it becomes the previous one and a fresh
+    reservoir starts. Reads pool both — a bounded, recency-faithful
+    estimate built from the SAME StreamingPercentiles machinery the r10
+    plane uses (merge() is the r15 satellite)."""
+
+    def __init__(self, window_s: float = 10.0, capacity: int = 2048, seed: int = 0):
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        self._window_s = window_s
+        self._capacity = capacity
+        self._seed = seed
+        self._lock = threading.Lock()
+        self._cur = StreamingPercentiles(capacity, seed=seed)
+        self._prev = StreamingPercentiles(capacity, seed=seed + 1)
+        self._t_rotate = time.monotonic() + window_s
+
+    def _maybe_rotate_locked(self) -> None:
+        now = time.monotonic()
+        if now >= self._t_rotate:
+            self._prev = self._cur
+            self._cur = StreamingPercentiles(self._capacity, seed=self._seed)
+            self._t_rotate = now + self._window_s
+
+    def add(self, value_ms: float) -> None:
+        with self._lock:
+            self._maybe_rotate_locked()
+            self._cur.add(value_ms)
+
+    def percentile(self, q: float) -> float | None:
+        """Pooled percentile over (previous + current) window; None until
+        any sample exists."""
+        with self._lock:
+            self._maybe_rotate_locked()
+            cur, prev = self._cur, self._prev
+        pooled = StreamingPercentiles(self._capacity, seed=self._seed)
+        pooled.merge(cur)
+        pooled.merge(prev)
+        return pooled.percentile(q)
+
+    def count(self) -> int:
+        with self._lock:
+            self._maybe_rotate_locked()
+            return self._cur.count + self._prev.count
+
+
+class FleetRouter:
+    """Admission + dispatch over the fleet's replicas.
+
+    ``replicas`` is a list of objects with ``.index``, ``.batcher`` (a
+    :class:`~fedcrack_tpu.serve.batcher.MicroBatcher`) and ``.alive`` —
+    ``serve.fleet.Replica``. The router exposes the batcher's ``submit``
+    surface so the gRPC front door works unchanged against one replica or a
+    fleet."""
+
+    def __init__(self, replicas: list, serve_config: Any, *, window_s: float = 10.0):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        self.replicas = list(replicas)
+        self.serve_config = serve_config
+        self._lock = make_lock("serve.router.dispatch")
+        self.rolling = RollingPercentiles(window_s=window_s)
+        self._shed_counts: dict[str, int] = {}
+        self._m_shed = REGISTRY.counter(
+            "serve_shed_total",
+            "requests refused at admission (RESOURCE_EXHAUSTED) by reason",
+            labels=("reason",),
+        )
+        self._m_replicas = REGISTRY.gauge(
+            "serve_fleet_replicas",
+            "live replica workers behind the fleet router",
+        )
+        self._m_replicas.set(sum(1 for r in self.replicas if r.alive))
+
+    # ---- admission control ----
+
+    def live_replicas(self) -> list:
+        return [r for r in self.replicas if r.alive]
+
+    def total_queued(self) -> int:
+        return sum(r.batcher.queued() for r in self.live_replicas())
+
+    def shed_reason(self) -> tuple[str, str] | None:
+        """(reason, detail) when the next request must be shed; None =
+        admit. Checked OUTSIDE the dispatch lock — both probes are
+        O(replicas) counter reads."""
+        bound = self.serve_config.queue_bound
+        if bound > 0:
+            queued = self.total_queued()
+            if queued >= bound:
+                return (
+                    SHED_QUEUE_BOUND,
+                    f"{queued} queued >= queue_bound {bound}",
+                )
+        slo = self.serve_config.slo_p95_ms
+        if slo > 0 and self.rolling.count() >= MIN_SHED_SAMPLES:
+            p95 = self.rolling.percentile(95.0)
+            if p95 is not None and p95 > slo:
+                return (
+                    SHED_P95_SLO,
+                    f"rolling p95 {p95:.1f} ms > SLO {slo:.1f} ms",
+                )
+        return None
+
+    def shed_counts(self) -> dict:
+        with self._lock:
+            return dict(self._shed_counts)
+
+    # ---- dispatch ----
+
+    def _pick(self, size: int):
+        """Least-outstanding live replica SERVING this bucket (ties ->
+        lowest index) — the same capability filter the kill-failover path
+        applies, so dispatch and reroute agree on heterogeneous fleets."""
+        live = [
+            r
+            for r in self.live_replicas()
+            if size in r.batcher.engine.bucket_sizes
+        ]
+        if not live:
+            raise RuntimeError(f"no live replica serves bucket {size}")
+        return min(live, key=lambda r: (r.batcher.outstanding(), r.index))
+
+    def submit(self, image_u8: np.ndarray, deadline_ms: float | None = None) -> Future:
+        """Admission-checked, least-outstanding-dispatched submit. Raises
+        :class:`LoadShedError` when admission control refuses (the caller
+        answers RESOURCE_EXHAUSTED); returns the replica batcher's Future
+        otherwise."""
+        shed = self.shed_reason()
+        if shed is not None:
+            reason, detail = shed
+            with self._lock:
+                self._shed_counts[reason] = self._shed_counts.get(reason, 0) + 1
+            self._m_shed.labels(reason=reason).inc()
+            from fedcrack_tpu.obs import flight
+
+            flight.note("serve.shed", reason=reason, detail=detail)
+            raise LoadShedError(reason, detail)
+        size = image_u8.shape[0]
+        # A replica may die between pick and submit (kill_replica closes its
+        # batcher after flipping alive); re-pick instead of failing an
+        # ADMITTED request — each retry sees one fewer live replica.
+        for _ in range(len(self.replicas) + 1):
+            with self._lock:
+                replica = self._pick(size)
+            try:
+                with tracing.span(
+                    "serve.route",
+                    trace=f"bucket-{size}",
+                    replica=replica.index,
+                    bucket=size,
+                    outstanding=replica.batcher.outstanding(),
+                ):
+                    fut = replica.batcher.submit(image_u8, deadline_ms=deadline_ms)
+            except RuntimeError:
+                if replica.alive:
+                    raise
+                continue
+            fut.add_done_callback(self._on_done)
+            return fut
+        raise RuntimeError("no live replicas")
+
+    def _on_done(self, fut: Future) -> None:
+        # Feed the rolling SLO probe from every answered request, whichever
+        # replica served it. Failed futures carry no latency — the p95
+        # probe measures served latency, the failure path is loud already.
+        if fut.cancelled() or fut.exception() is not None:
+            return
+        self.rolling.add(fut.result().latency_ms)
+
+    # ---- replica lifecycle ----
+
+    def kill_replica(self, index: int) -> dict:
+        """Take replica ``index`` out of rotation (the chaos drill's crash)
+        and reroute its queued requests to survivors with their original
+        futures — zero accepted requests dropped. Returns the reroute
+        accounting. In-flight batches on the dying replica complete first
+        (their snapshot was taken); with no survivors the drained requests
+        fail loudly instead of hanging."""
+        with self._lock:
+            replica = self.replicas[index]
+            if not replica.alive:
+                return {"rerouted": 0, "failed": 0, "already_dead": True}
+            replica.alive = False
+        leftovers = replica.batcher.drain()
+        rerouted = failed = 0
+        for req in leftovers:
+            survivors = self.live_replicas()
+            target = None
+            for r in sorted(survivors, key=lambda r: (r.batcher.outstanding(), r.index)):
+                if req.image.shape[0] in r.batcher.engine.bucket_sizes:
+                    target = r
+                    break
+            if target is None:
+                failed += 1
+                if not req.future.done():
+                    req.future.set_exception(
+                        RuntimeError("replica crashed with no survivor for its bucket")
+                    )
+                continue
+            target.batcher.resubmit(req)
+            rerouted += 1
+        self._m_replicas.set(sum(1 for r in self.replicas if r.alive))
+        from fedcrack_tpu.obs import flight
+
+        flight.note(
+            "serve.replica_killed", replica=index, rerouted=rerouted, failed=failed
+        )
+        return {"rerouted": rerouted, "failed": failed, "already_dead": False}
+
+    def stats(self) -> dict:
+        """Fleet-level snapshot: per-replica batcher stats + shed counts +
+        the rolling p95 the admission probe reads."""
+        return {
+            "replicas": len(self.replicas),
+            "live": len(self.live_replicas()),
+            "shed": self.shed_counts(),
+            "rolling_p95_ms": self.rolling.percentile(95.0),
+            "per_replica": {
+                str(r.index): {"alive": r.alive, **r.batcher.stats()}
+                for r in self.replicas
+            },
+        }

@@ -41,6 +41,10 @@ PREDICT_PATH = f"/{SERVE_SERVICE_NAME}/{PREDICT_METHOD}"
 
 OK = "OK"
 REJECTED = "REJECTED"
+# Admission-control shed (round 17, serve/router.py): the fleet refused the
+# request BEFORE queueing it — the gRPC-status-code-shaped loud reject a
+# client backs off on, distinct from REJECTED (malformed request).
+SHED = "RESOURCE_EXHAUSTED"
 
 # Per-stream assembly caps: chunks accumulate server-side until `last`, so an
 # unbounded stream of never-finishing requests must hit a ceiling — on total
@@ -74,6 +78,7 @@ class ServeService:
         self._lock = make_lock("serve.service.stats")
         self.tiled_served = 0
         self.rejected = 0
+        self.shed = 0
 
     # ---- request assembly ----
 
@@ -207,6 +212,17 @@ class ServeService:
             try:
                 yield await self._serve_one(msg.request_id, image, p)
             except Exception as e:  # a failed batch errors THIS request only
+                from fedcrack_tpu.serve.router import LoadShedError
+
+                if isinstance(e, LoadShedError):
+                    # Admission control fired: loud RESOURCE_EXHAUSTED with
+                    # the shed reason — never a silent drop, never a stall.
+                    with self._lock:
+                        self.shed += 1
+                    yield pb.PredictResponse(
+                        request_id=msg.request_id, status=SHED, title=str(e)
+                    )
+                    continue
                 log.exception("predict failed for request %d", msg.request_id)
                 with self._lock:
                     self.rejected += 1

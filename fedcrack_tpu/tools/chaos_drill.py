@@ -729,6 +729,114 @@ def run_buffered_kill_drill(workdir: str | None = None) -> dict:
             ctx.cleanup()
 
 
+def run_replica_crash_drill() -> dict:
+    """Serve-fleet replica-crash drill (round 17, SERVE_REPLICA_CRASH).
+
+    A 2-replica fleet (tiny model, shared engine) under concurrent load:
+    one replica is killed mid-load with requests still queued on it — the
+    router drains that queue to the survivor WITH the original futures, so
+    every accepted request answers (zero drops). Then the fleet-wide
+    two-phase swap is driven on the surviving topology and must land: every
+    post-commit request answers from the new version (zero torn versions on
+    a degraded fleet). The kill is scheduled and consumed through a chaos
+    FaultPlan so the artifact proves it fired."""
+    import threading
+
+    import jax
+
+    from fedcrack_tpu.chaos.plan import SERVE_REPLICA_CRASH, Fault, FaultPlan
+    from fedcrack_tpu.configs import ModelConfig, ServeConfig
+    from fedcrack_tpu.models.resunet import init_variables
+    from fedcrack_tpu.serve.fleet import ServeFleet
+
+    model_config = ModelConfig(
+        img_size=16, stem_features=4, encoder_features=(8,), decoder_features=(8, 4)
+    )
+    serve_config = ServeConfig(
+        bucket_sizes=(16,),
+        max_batch=4,
+        max_delay_ms=30.0,
+        tile_overlap=4,
+        replicas=2,
+    )
+    v0 = init_variables(jax.random.key(0), model_config)
+    v1 = init_variables(jax.random.key(1), model_config)
+    plan = FaultPlan([Fault(kind=SERVE_REPLICA_CRASH, round=1)])
+
+    class _SlowBatches:
+        """Batcher chaos hook stretching every dispatch, so a queued
+        BACKLOG provably exists on the victim at kill time (a tiny CPU
+        model would otherwise drain its queue before the kill lands and
+        the reroute path would go untested)."""
+
+        def on_batch(self, bucket, batch_index, attempt):
+            time.sleep(0.08)
+
+    fleet = ServeFleet(
+        model_config, serve_config, v0, initial_version=0, chaos=_SlowBatches()
+    )
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, (16, 16, 3), dtype=np.uint8)
+    t_start = time.perf_counter()
+    try:
+        # Phase 1: a burst wide enough that BOTH replicas hold queued work
+        # (least-outstanding routing alternates them), submitted from
+        # threads like real front-door traffic.
+        n_burst = 24
+        futures = []
+        fut_lock = threading.Lock()
+
+        def submit_some(n):
+            for _ in range(n):
+                f = fleet.submit(img)
+                with fut_lock:
+                    futures.append(f)
+
+        threads = [
+            threading.Thread(target=submit_some, args=(n_burst // 4,))
+            for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # Phase 2: the scheduled crash — consumed from the plan (the
+        # artifact's proof it fired), executed by the router's kill path.
+        fault = plan.take(SERVE_REPLICA_CRASH, round=1)
+        assert fault is not None
+        victim = 1
+        t_kill = time.perf_counter()
+        reroute = fleet.router.kill_replica(victim)
+        # Phase 3: every accepted request answers (original futures).
+        results = [f.result(timeout=60) for f in futures]
+        answered = len(results)
+        # Phase 4: the fleet swap still lands on the degraded fleet.
+        installed = fleet.install(1, v1)
+        post = [fleet.submit(img) for _ in range(4)]
+        post_versions = sorted({f.result(timeout=60).model_version for f in post})
+        stats = fleet.router.stats()
+        return {
+            "replicas": serve_config.replicas,
+            "burst": n_burst,
+            "fault_fired": fault.kind,
+            "victim": victim,
+            "rerouted": reroute["rerouted"],
+            "reroute_failed": reroute["failed"],
+            "answered": answered,
+            "dropped": n_burst - answered,
+            "zero_dropped": answered == n_burst,
+            "live_after_kill": stats["live"],
+            "swap_installed": installed,
+            "post_swap_versions": post_versions,
+            "swap_landed_untorn": installed and post_versions == [1],
+            "swap_pause_ms": (fleet.manager.last_swap or {}).get("pause_ms"),
+            "kill_to_drained_s": round(time.perf_counter() - t_kill, 3),
+            "drill_s": round(time.perf_counter() - t_start, 3),
+        }
+    finally:
+        fleet.close()
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--out", required=True)
@@ -750,6 +858,7 @@ def main(argv=None) -> int:
             "edge_crash": run_edge_crash_drill(),
             "straggler_storm": run_straggler_storm_drill(),
             "buffered_kill": run_buffered_kill_drill(),
+            "replica_crash": run_replica_crash_drill(),
         }
     except BaseException:
         flight.dump("chaos drill failed")

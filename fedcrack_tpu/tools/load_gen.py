@@ -12,9 +12,23 @@ Modes:
 - **closed** (default): ``concurrency`` workers, each with its own stream,
   one request in flight per worker — latency under a fixed multiprogramming
   level (the classic closed-loop SLO probe).
-- **open**: one stream, requests injected at a fixed ``rate_rps`` regardless
-  of completions (sender/receiver threads) — the overload-behavior probe; a
-  server that falls behind shows it as growing latency, never as drops.
+- **open**: requests injected on the arrival schedule at ``rate_rps``
+  regardless of completions, dealt round-robin over ``concurrency``
+  parallel streams (the server handles one request per stream at a time,
+  so multiple streams are what lets an open-loop run actually outpace the
+  service rate) — the overload-behavior probe; a server that falls behind
+  shows it as growing latency or loud sheds, never as drops.
+
+Arrival profiles (round 17, open mode): ``--profile const`` keeps the fixed
+injection rate; ``ramp`` steps the rate through 0.25x/0.5x/1x/2x of
+``rate_rps`` (equal request counts per phase, seeded Poisson gaps) and
+``diurnal`` replays a compressed day (night/morning/peak/evening at
+0.2x/1x/1.8x/0.8x). Both are the load shapes that prove the fleet's
+admission control: the summary reports shed requests (``RESOURCE_EXHAUSTED``
+responses — counted separately from rejects and NEVER as drops; a shed
+client got a loud answer) and client-side p50/p95/p99 PER PHASE, so an
+artifact shows latency held inside SLO at 1x while the 2x/peak phase shed
+the overflow instead of melting.
 
 ``--swap-statefile``/``--swap-after`` publish new weights (a bumped
 ``model_version`` statefile, ``serve.hot_swap.publish_statefile``) after the
@@ -39,9 +53,72 @@ import numpy as np
 from fedcrack_tpu.obs.metrics import StreamingPercentiles
 from fedcrack_tpu.transport import transport_pb2 as pb
 from fedcrack_tpu.transport.service import channel_options
-from fedcrack_tpu.serve.service import OK, PREDICT_PATH
+from fedcrack_tpu.serve.service import OK, PREDICT_PATH, SHED
 
 _STOP = object()
+
+# (phase name, rate multiplier) sequences for the seeded arrival profiles.
+RAMP_PHASES = (
+    ("ramp_0.25x", 0.25),
+    ("ramp_0.5x", 0.5),
+    ("ramp_1x", 1.0),
+    ("ramp_2x", 2.0),
+)
+DIURNAL_PHASES = (
+    ("diurnal_night", 0.2),
+    ("diurnal_morning", 1.0),
+    ("diurnal_peak", 1.8),
+    ("diurnal_evening", 0.8),
+)
+PROFILES = ("const", "ramp", "diurnal")
+
+
+def arrival_schedule(
+    profile: str, n: int, rate_rps: float, seed: int = 0
+) -> tuple[list[float], list[int], list[dict]]:
+    """Seeded send schedule for ``n`` open-loop requests.
+
+    Returns ``(offsets_s, phase_of, phase_meta)``: per-request send offsets
+    from the run start (strictly non-decreasing), each request's phase
+    index, and per-phase metadata (name, target rate, request count). Same
+    (profile, n, rate_rps, seed) -> same schedule, so a shed-count artifact
+    is replayable. ``const`` uses fixed periods (the pre-r17 behavior);
+    ``ramp``/``diurnal`` draw exponential inter-arrival gaps (Poisson
+    arrivals) at each phase's target rate from one seeded rng."""
+    import random
+
+    if profile not in PROFILES:
+        raise ValueError(f"profile must be one of {PROFILES}, got {profile!r}")
+    if n < 1:
+        raise ValueError(f"n must be >= 1, got {n}")
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+    if profile == "const":
+        period = 1.0 / rate_rps
+        offsets = [i * period for i in range(n)]
+        return (
+            offsets,
+            [0] * n,
+            [{"phase": "const", "target_rps": rate_rps, "requests": n}],
+        )
+    phases = RAMP_PHASES if profile == "ramp" else DIURNAL_PHASES
+    per = [n // len(phases)] * len(phases)
+    per[-1] += n - sum(per)
+    rng = random.Random(f"load_gen/{profile}/{seed}")
+    offsets: list[float] = []
+    phase_of: list[int] = []
+    meta: list[dict] = []
+    t = 0.0
+    for pi, ((name, mult), count) in enumerate(zip(phases, per)):
+        rate = rate_rps * mult
+        meta.append(
+            {"phase": name, "target_rps": round(rate, 3), "requests": count}
+        )
+        for _ in range(count):
+            offsets.append(t)
+            phase_of.append(pi)
+            t += rng.expovariate(rate)
+    return offsets, phase_of, meta
 
 
 def make_images(
@@ -105,25 +182,63 @@ def _request_chunks(
 class _Collector:
     """Thread-safe result aggregation shared by all workers."""
 
-    def __init__(self):
+    def __init__(self, phase_meta: list[dict] | None = None):
         self.lock = threading.Lock()
         self.latency = StreamingPercentiles(8192)
         self.completed = 0
         self.rejected = 0
+        self.shed = 0
         self.deadline_missed = 0
         self.per_size: dict[str, int] = {}
         self.versions: dict[str, int] = {}
         self.server_latency = StreamingPercentiles(8192)
         self.masks: list[tuple[int, int, int, bytes]] = []
+        # Per-phase accounting (round 17 profiles): one slot per phase of
+        # the arrival schedule — completions, sheds and a client-side
+        # latency reservoir each.
+        self.phases = [
+            {
+                "meta": m,
+                "completed": 0,
+                "shed": 0,
+                "rejected": 0,
+                "latency": StreamingPercentiles(4096),
+            }
+            for m in (phase_meta or [])
+        ]
 
-    def record(self, resp: pb.PredictResponse, latency_s: float, keep_mask: bool):
+    def record(
+        self,
+        resp: pb.PredictResponse,
+        latency_s: float,
+        keep_mask: bool,
+        phase: int | None = None,
+    ):
         with self.lock:
+            slot = (
+                self.phases[phase]
+                if phase is not None and phase < len(self.phases)
+                else None
+            )
+            if resp.status == SHED:
+                # A shed is a LOUD answer, not a drop: counted apart from
+                # rejects so an artifact can say "admission control fired
+                # N times" instead of "N requests failed".
+                self.shed += 1
+                if slot is not None:
+                    slot["shed"] += 1
+                return
             if resp.status != OK:
                 self.rejected += 1
+                if slot is not None:
+                    slot["rejected"] += 1
                 return
             self.completed += 1
             self.latency.add(latency_s * 1e3)
             self.server_latency.add(resp.latency_ms)
+            if slot is not None:
+                slot["completed"] += 1
+                slot["latency"].add(latency_s * 1e3)
             key = f"{resp.height}x{resp.width}"
             self.per_size[key] = self.per_size.get(key, 0) + 1
             v = str(resp.model_version)
@@ -132,6 +247,26 @@ class _Collector:
                 self.masks.append(
                     (int(resp.request_id), resp.height, resp.width, resp.mask)
                 )
+
+    def per_phase_summary(self) -> list[dict] | None:
+        with self.lock:
+            if not self.phases:
+                return None
+            out = []
+            for slot in self.phases:
+                s = slot["latency"].summary()
+                out.append(
+                    {
+                        **slot["meta"],
+                        "completed": slot["completed"],
+                        "shed": slot["shed"],
+                        "rejected": slot["rejected"],
+                        "latency_ms": {
+                            k: s[k] for k in ("count", "p50", "p95", "p99")
+                        },
+                    }
+                )
+            return out
 
 
 def _stream_call(channel):
@@ -187,10 +322,21 @@ def _closed_worker(
         send_q.put(_STOP)
 
 
-def _open_loop(
-    stub, images: list, collector: _Collector, opts: dict, rate_rps: float, on_complete
+def _open_stream(
+    stub,
+    jobs: list,                # [(rid, image, offset_s)] for THIS stream
+    t_start: float,
+    collector: _Collector,
+    opts: dict,
+    phase_of: list[int],
+    on_complete,
 ) -> None:
-    """One stream; a sender injects at the target rate, a receiver drains."""
+    """One open-loop stream: a sender injects its slice of the arrival
+    schedule at ABSOLUTE offsets from the shared run start, a receiver
+    drains. The server handles one request per stream at a time, so
+    open-loop overload pressure comes from running SEVERAL of these in
+    parallel (``concurrency`` streams) — one stream alone is throttled to
+    the service latency, whatever the nominal rate."""
     send_q: Queue = Queue()
     t_sent: dict[int, float] = {}
     lock = threading.Lock()
@@ -205,27 +351,31 @@ def _open_loop(
     responses = stub(request_iter())
 
     def receiver():
-        for _ in range(len(images)):
+        for _ in range(len(jobs)):
             try:
                 resp = next(responses)
             except StopIteration:
                 return
+            rid = int(resp.request_id)
             with lock:
-                t0 = t_sent.pop(int(resp.request_id), None)
+                t0 = t_sent.pop(rid, None)
             lat = (time.perf_counter() - t0) if t0 is not None else 0.0
-            collector.record(resp, lat, opts["keep_masks"])
+            collector.record(
+                resp,
+                lat,
+                opts["keep_masks"],
+                phase=phase_of[rid] if rid < len(phase_of) else None,
+            )
             if on_complete is not None:
                 on_complete()
 
     rx = threading.Thread(target=receiver, daemon=True)
     rx.start()
-    period = 1.0 / max(rate_rps, 1e-6)
-    t_next = time.perf_counter()
-    for rid, image in enumerate(images):
+    for rid, image, offset in jobs:
+        t_target = t_start + offset
         now = time.perf_counter()
-        if now < t_next:
-            time.sleep(t_next - now)
-        t_next += period
+        if now < t_target:
+            time.sleep(t_target - now)
         with lock:
             t_sent[rid] = time.perf_counter()
         send_q.put(
@@ -244,6 +394,41 @@ def _open_loop(
     send_q.put(_STOP)
 
 
+def _open_loop(
+    make_stub,
+    images: list,
+    collector: _Collector,
+    opts: dict,
+    offsets: list[float],
+    phase_of: list[int],
+    on_complete,
+    n_streams: int = 1,
+) -> None:
+    """Open-loop injection over ``n_streams`` parallel streams: requests
+    are dealt round-robin (each keeps its ABSOLUTE schedule offset, so the
+    aggregate arrival process matches the profile), and each stream runs an
+    independent sender/receiver pair."""
+    n_streams = max(1, n_streams)
+    per_stream: list[list] = [[] for _ in range(n_streams)]
+    for rid, image in enumerate(images):
+        per_stream[rid % n_streams].append((rid, image, offsets[rid]))
+    t_start = time.perf_counter()
+    threads = [
+        threading.Thread(
+            target=_open_stream,
+            args=(make_stub(), jobs, t_start, collector, opts, phase_of, on_complete),
+            daemon=True,
+        )
+        for jobs in per_stream
+        if jobs
+    ]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + opts["timeout_s"]
+    for t in threads:
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+
+
 def run_load(
     target: str,
     *,
@@ -251,6 +436,7 @@ def run_load(
     n_requests: int = 64,
     concurrency: int = 4,
     rate_rps: float = 50.0,
+    profile: str = "const",
     sizes: Sequence[int] = (128,),
     seed: int = 0,
     threshold: float = 0.5,
@@ -269,8 +455,16 @@ def run_load(
 
     if mode not in ("closed", "open"):
         raise ValueError(f"mode must be 'closed' or 'open', got {mode!r}")
+    if profile != "const" and mode != "open":
+        raise ValueError(
+            f"profile {profile!r} needs open-loop injection (--mode open); "
+            "closed-loop pacing is completion-driven"
+        )
     images = make_images(n_requests, sizes, seed)
-    collector = _Collector()
+    offsets, phase_of, phase_meta = arrival_schedule(
+        profile, n_requests, rate_rps, seed
+    )
+    collector = _Collector(phase_meta if mode == "open" else None)
     opts = {
         "threshold": threshold,
         "deadline_ms": deadline_ms,
@@ -302,7 +496,16 @@ def run_load(
             for w in workers:
                 w.join(timeout=max(0.0, deadline - time.monotonic()))
         else:
-            _open_loop(stub, images, collector, opts, rate_rps, on_complete)
+            _open_loop(
+                lambda: _stream_call(channel),
+                images,
+                collector,
+                opts,
+                offsets,
+                phase_of,
+                on_complete,
+                n_streams=max(1, concurrency),
+            )
     finally:
         channel.close()
     wall_s = time.perf_counter() - t_start
@@ -310,6 +513,7 @@ def run_load(
     with collector.lock:
         completed = collector.completed
         rejected = collector.rejected
+        shed = collector.shed
         per_size = dict(collector.per_size)
         versions = dict(collector.versions)
     return {
@@ -318,11 +522,14 @@ def run_load(
         "n_requests": n_requests,
         "completed": completed,
         "rejected": rejected,
-        "dropped": n_requests - completed - rejected,
+        "shed": shed,
+        "dropped": n_requests - completed - rejected - shed,
         "wall_s": round(wall_s, 3),
         "throughput_rps": round(completed / wall_s, 3) if wall_s > 0 else None,
-        "concurrency": concurrency if mode == "closed" else None,
+        "concurrency": concurrency,
         "rate_rps": rate_rps if mode == "open" else None,
+        "profile": profile,
+        "per_phase": collector.per_phase_summary(),
         "sizes": list(sizes),
         "per_size": per_size,
         "versions_observed": versions,
@@ -359,6 +566,13 @@ def main(argv=None) -> int:
     p.add_argument("--requests", type=int, default=64)
     p.add_argument("--concurrency", type=int, default=4)
     p.add_argument("--rate-rps", type=float, default=50.0)
+    p.add_argument(
+        "--profile",
+        choices=list(PROFILES),
+        default="const",
+        help="open-loop arrival profile: const (fixed rate), ramp "
+        "(0.25x->2x rate steps), diurnal (compressed-day replay); seeded",
+    )
     p.add_argument("--sizes", default="128", help="comma-separated request sizes")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--threshold", type=float, default=0.5)
@@ -375,6 +589,12 @@ def main(argv=None) -> int:
     p.add_argument("--swap-seed", type=int, default=1)
     p.add_argument("--img-size", type=int, default=128,
                    help="model config size for --swap-statefile weights init")
+    p.add_argument(
+        "--swap-config",
+        help="FedConfig JSON whose model section shapes the --swap-statefile "
+        "weights (the published tree must match the SERVED model; overrides "
+        "--img-size)",
+    )
     args = p.parse_args(argv)
 
     sizes = tuple(int(s) for s in args.sizes.split(",") if s.strip())
@@ -387,14 +607,17 @@ def main(argv=None) -> int:
         # publish past the end of the run.
         import jax
 
-        from fedcrack_tpu.configs import ModelConfig
+        from fedcrack_tpu.configs import FedConfig, ModelConfig
         from fedcrack_tpu.fed.serialization import tree_to_bytes
         from fedcrack_tpu.models.resunet import init_variables
 
+        if args.swap_config:
+            with open(args.swap_config) as f:
+                swap_model = FedConfig.from_json(f.read()).model
+        else:
+            swap_model = ModelConfig(img_size=args.img_size)
         swap_blob = tree_to_bytes(
-            init_variables(
-                jax.random.key(args.swap_seed), ModelConfig(img_size=args.img_size)
-            )
+            init_variables(jax.random.key(args.swap_seed), swap_model)
         )
 
     def on_complete():
@@ -414,6 +637,7 @@ def main(argv=None) -> int:
         n_requests=args.requests,
         concurrency=args.concurrency,
         rate_rps=args.rate_rps,
+        profile=args.profile,
         sizes=sizes,
         seed=args.seed,
         threshold=args.threshold,

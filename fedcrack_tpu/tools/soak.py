@@ -641,10 +641,15 @@ def run_soak(
 
         return sample_value(parsed, name, labels)
 
-    from fedcrack_tpu.obs.spans import read_spans
+    from fedcrack_tpu.obs.spans import read_spans, span_files
     from fedcrack_tpu.tools.trace_stitch import stitch_files, summarize
 
-    span_records = read_spans(spans_path)
+    # The census must cover the whole ROTATED set (this run arms 64 MiB
+    # rotation): reading only the live file would silently undercount an
+    # hours-long soak's early spans.
+    span_records = [
+        rec for path in span_files(spans_path) for rec in read_spans(path)
+    ]
     span_names: dict[str, int] = {}
     for rec in span_records:
         span_names[rec["name"]] = span_names.get(rec["name"], 0) + 1

@@ -608,6 +608,12 @@ def test_committed_lock_graph_artifact_is_current_and_acyclic():
         "fedcrack_tpu/serve/batcher.py::MicroBatcher._lock",
         "fedcrack_tpu/serve/hot_swap.py::ModelVersionManager._lock",
         "fedcrack_tpu/serve/service.py::ServeService._lock",
+        # Round 17: the fleet plane — commit-barrier slot lock, router
+        # dispatch lock, rolling-SLO window lock (all leaf-or-acyclic;
+        # router -> batcher is the graph's one sanctioned edge).
+        "fedcrack_tpu/serve/fleet.py::FleetVersionManager._lock",
+        "fedcrack_tpu/serve/router.py::FleetRouter._lock",
+        "fedcrack_tpu/serve/router.py::RollingPercentiles._lock",
     }
 
 

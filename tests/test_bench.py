@@ -218,6 +218,11 @@ def test_detail_schema_declares_contract_keys():
     assert {"bytes_per_round", "ratio_vs_null", "encode_ms", "decode_ms"} <= set(
         bench.COMPRESSION_WIRE_SCHEMA
     )
+    # Round-17 serve-fleet arm: the grid/swap/shed keys BASELINE.md reads.
+    assert {"grid", "swap", "shed", "quant_gate"} <= set(bench.SERVE_FLEET_SCHEMA)
+    assert {"replicas", "quant", "throughput_rps", "p95_ms"} <= set(
+        bench.SERVE_FLEET_ARM_SCHEMA
+    )
     # The schema cannot drift from the code that writes the payload: every
     # declared key must appear as a literal in bench.py's emitting code.
     with open(bench.__file__) as f:
@@ -228,6 +233,8 @@ def test_detail_schema_declares_contract_keys():
         | set(bench.SERVING_SCHEMA)
         | set(bench.COMPRESSION_SCHEMA)
         | set(bench.COMPRESSION_WIRE_SCHEMA)
+        | set(bench.SERVE_FLEET_SCHEMA)
+        | set(bench.SERVE_FLEET_ARM_SCHEMA)
     ):
         assert f'"{key}"' in src, f"schema key {key!r} never written by bench.py"
 
@@ -308,6 +315,56 @@ def test_validate_detail_typed_checks():
         resident_pool={"x": {"resident": {"round_ms": "slow"}}},
     )
     assert any("resident_pool" in v for v in bench.validate_detail(bad3))
+    # Round-17 serve-fleet arm: error-arm exempt, present arm fully typed,
+    # per-arm grid points typed, non-dict points reported never crashed.
+    assert bench.validate_detail({"serve_fleet": {"error": "boom"}}) == []
+    fleet_ok = {
+        "serve_fleet": {
+            "buckets": [128, 256],
+            "max_batch": 8,
+            "grid": {
+                "r2_int8": {
+                    "replicas": 2,
+                    "quant": "int8",
+                    "served_quant": True,
+                    "requests": 64,
+                    "completed": 64,
+                    "throughput_rps": 120.5,
+                    "p50_ms": 30.0,
+                    "p95_ms": 55.0,
+                }
+            },
+            "swap": {"pause_ms": 0.3, "torn_versions": 0, "zero_torn": True},
+            "shed": {"total": 7, "by_reason": {"queue_bound": 7}},
+            "quant_gate": {"passed": True, "iou": 0.99},
+        }
+    }
+    assert bench.validate_detail(fleet_ok) == []
+    assert any(
+        "serve_fleet" in v for v in bench.validate_detail({"serve_fleet": {"grid": {}}})
+    )
+    fleet_bad = {
+        "serve_fleet": dict(
+            fleet_ok["serve_fleet"], grid={"r1_bf16": {"replicas": "two"}}
+        )
+    }
+    assert any(
+        "serve_fleet.grid" in v for v in bench.validate_detail(fleet_bad)
+    )
+    fleet_bad2 = {
+        "serve_fleet": dict(fleet_ok["serve_fleet"], grid={"r1_bf16": ["x"]})
+    }
+    assert any(
+        "serve_fleet.grid['r1_bf16']" in v
+        for v in bench.validate_detail(fleet_bad2)
+    )
+    # quant_gate None = quant disabled this run — legal.
+    assert (
+        bench.validate_detail(
+            {"serve_fleet": dict(fleet_ok["serve_fleet"], quant_gate=None)}
+        )
+        == []
+    )
     # Round-12 compression arm: error-arm exempt, present arm fully typed.
     assert bench.validate_detail({"update_compression": {"error": "boom"}}) == []
     assert any(
