@@ -115,6 +115,8 @@ FEDCRACK_BENCH_COMPRESSION=0 (skip the update-compression A/B)
 FEDCRACK_BENCH_COMPRESSION_ROUNDS=3 (mesh-twin trajectory rounds).
 FEDCRACK_BENCH_OBSERVABILITY=0 (skip the round-15 concurrent mini-soak)
 FEDCRACK_BENCH_SOAK_S=8 (the soak's traffic wall in seconds)
+FEDCRACK_BENCH_HEALTH=0 (skip the round-18 federation-health drill,
+detail.federation_health)
 """
 
 from __future__ import annotations
@@ -173,6 +175,7 @@ DETAIL_SCHEMA: dict = {
     "cohort_scale": dict,
     "async_federation": dict,
     "observability": dict,
+    "federation_health": dict,
 }
 # Typed keys of detail.observability (round 15): the concurrent mini-soak's
 # contract — the self-scrape must cover all five instrumented planes and
@@ -232,6 +235,44 @@ OBSERVABILITY_WATCHDOG_SCHEMA: dict = {
     "all_rules_evaluated": bool,
     "breaches": list,
     "clean": bool,
+}
+# Typed keys of detail.federation_health (round 18): the SCALED_UPDATE
+# chaos drill — FedAvg's sanitation gate ACCEPTS the norm-bounded-but-
+# scaled update (it is finite and well-formed), the per-client ledger's
+# robust-z anomaly score flags it, the canary IoU falls off a cliff on the
+# poisoned install, and the health SLO watchdog turns that into a breach +
+# flight dump + exit-3 verdict. Three sub-blocks, one per plane.
+FEDERATION_HEALTH_SCHEMA: dict = {
+    "ledger": dict,
+    "canary": dict,
+    "watchdog": dict,
+}
+FEDERATION_HEALTH_LEDGER_SCHEMA: dict = {
+    "fault_fired": str,
+    "poisoned_accepted": bool,
+    "honest_accepted": bool,
+    "nothing_rejected": bool,
+    "global_drag_matches_fedavg": bool,
+    "anomaly_scores": dict,
+    "alert_threshold": (int, float),
+    "poisoned_flagged": bool,
+    "honest_below_alert": bool,
+    "flagged_flushes": int,
+}
+FEDERATION_HEALTH_CANARY_SCHEMA: dict = {
+    "reference_iou": (int, float),
+    "poisoned_iou": (int, float),
+    "iou_cliff": bool,
+    "swap_still_installed": bool,
+    "recompiles_since_warmup": int,
+}
+FEDERATION_HEALTH_WATCHDOG_SCHEMA: dict = {
+    "rules": list,
+    "breached": list,
+    "both_signals_breached": bool,
+    "flight_dumped": bool,
+    "breach_exit_code": int,
+    "would_exit": int,
 }
 # Typed keys of detail.async_federation (round 14): the buffered-async
 # contract — the chaos straggler-storm sync-vs-buffered A/B at equal wall,
@@ -477,6 +518,33 @@ def validate_detail(detail: dict) -> list:
                         f"observability.{key}[{sub!r}]: "
                         f"{type(obsy[key][sub]).__name__}"
                     )
+    health = detail.get("federation_health")
+    if isinstance(health, dict) and "error" not in health:
+        for key, typs in FEDERATION_HEALTH_SCHEMA.items():
+            if key not in health:
+                bad.append(f"federation_health[{key!r}] missing")
+            elif not isinstance(health[key], typs):
+                bad.append(
+                    f"federation_health[{key!r}]: {type(health[key]).__name__}"
+                )
+        for block_key, sub_schema in (
+            ("ledger", FEDERATION_HEALTH_LEDGER_SCHEMA),
+            ("canary", FEDERATION_HEALTH_CANARY_SCHEMA),
+            ("watchdog", FEDERATION_HEALTH_WATCHDOG_SCHEMA),
+        ):
+            block = health.get(block_key)
+            if not isinstance(block, dict):
+                continue
+            for key, typs in sub_schema.items():
+                if key not in block:
+                    bad.append(
+                        f"federation_health.{block_key}[{key!r}] missing"
+                    )
+                elif not isinstance(block[key], typs):
+                    bad.append(
+                        f"federation_health.{block_key}[{key!r}]: "
+                        f"{type(block[key]).__name__}"
+                    )
     cohort = detail.get("cohort_scale")
     if isinstance(cohort, dict) and "error" not in cohort:
         for key, typs in COHORT_SCALE_SCHEMA.items():
@@ -556,6 +624,14 @@ ASYNC = os.environ.get("FEDCRACK_BENCH_ASYNC", "1") == "1"
 OBSERVABILITY = os.environ.get("FEDCRACK_BENCH_OBSERVABILITY", "1") == "1"
 SOAK_S = float(os.environ.get("FEDCRACK_BENCH_SOAK_S", "8"))
 ASYNC_SEED = int(os.environ.get("FEDCRACK_BENCH_ASYNC_SEED", "0"))
+
+# Federation-health section (round 18, detail.federation_health): the
+# SCALED_UPDATE chaos drill — a sanitation-passing scaled update that
+# FedAvg accepts, the per-client ledger's robust-z anomaly flag, the
+# canary IoU cliff on the poisoned install, and the health SLO watchdog's
+# breach → flight dump → exit-3 verdict. Host + tiny engine, seconds.
+# "0" opts out.
+HEALTH = os.environ.get("FEDCRACK_BENCH_HEALTH", "1") == "1"
 
 # Serving-plane SLO section (round 10, detail.serving): boots the full
 # serve stack in-process (engine + micro-batcher + hot-swap manager + gRPC
@@ -2677,6 +2753,15 @@ def _bench_observability() -> dict:
     return run_soak(duration_s=SOAK_S, seed=0)
 
 
+def _bench_federation_health() -> dict:
+    """detail.federation_health (round 18): the SCALED_UPDATE end-to-end
+    drill — sanitation accepts, ledger flags, canary IoU regresses,
+    watchdog breaches with a flight dump."""
+    from fedcrack_tpu.tools.chaos_drill import run_scaled_update_drill
+
+    return run_scaled_update_drill()
+
+
 def main() -> None:
     # Smoke-test hook: this image pre-imports jax at interpreter startup with
     # the axon (real TPU tunnel) platform, so a JAX_PLATFORMS=cpu env override
@@ -3313,6 +3398,30 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
         else:
             _skip(
                 skips, "observability", obsy_est, "estimate exceeds remaining budget"
+            )
+
+    # ---- federation health (round 18): the SCALED_UPDATE drill — the
+    # sanitation gate accepts a scaled-but-finite update, the per-client
+    # ledger's robust-z score flags it, the canary IoU cliffs on the
+    # poisoned install, and the health watchdog turns the pair of signals
+    # into a breach + flight dump + exit-3 verdict ----
+    if HEALTH:
+        health_est = 30.0  # one 1-round federation + tiny-engine compile
+        if _fits(health_est):
+            t0 = time.monotonic()
+            try:
+                detail["federation_health"] = _bench_federation_health()
+            except Exception as e:  # a host-only extra must never kill the artifact
+                detail["federation_health"] = {"error": repr(e)}
+            section_s["federation_health"] = time.monotonic() - t0
+            detail["budget"] = _budget_detail()
+            _set_payload(metric_headline, value, vs_baseline, detail)
+        else:
+            _skip(
+                skips,
+                "federation_health",
+                health_est,
+                "estimate exceeds remaining budget",
             )
 
     # ---- batch-scaling curve (bf16 flagship at batch 32/64; non-parity

@@ -17,6 +17,7 @@ def all_rules() -> list[Rule]:
         deadcode,
         determinism,
         durability,
+        health_plane,
         locks,
         obs_plane,
         trace,
@@ -26,7 +27,7 @@ def all_rules() -> list[Rule]:
     out: list[Rule] = []
     for pack in (
         determinism, durability, trace, transport, compress, async_plane,
-        obs_plane, locks, deadcode,
+        obs_plane, health_plane, locks, deadcode,
     ):
         out.extend(cls() for cls in pack.RULES)
     return out

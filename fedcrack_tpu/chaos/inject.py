@@ -43,6 +43,7 @@ from fedcrack_tpu.chaos.plan import (
     MESH_NONFINITE,
     NAN_UPDATE,
     NETWORK_FLAP,
+    SCALED_UPDATE,
     SERVE_DEVICE_LOSS,
     SERVE_SWAP_MIDFLIGHT,
     STALE_REPLAY,
@@ -50,6 +51,11 @@ from fedcrack_tpu.chaos.plan import (
     TRUNCATE_PAYLOAD,
     FaultPlan,
 )
+
+# SCALED_UPDATE's amplification factor: large enough that a x-scaled real
+# update is unmistakably outside any honest cohort's norm spread, small
+# enough that float32 stays finite for any realistic weight magnitude.
+SCALE_FACTOR = 1000.0
 
 
 class InjectedCrash(Exception):
@@ -170,6 +176,65 @@ def _poison_weights(blob: bytes, mode: str) -> bytes:
                 arr = np.full_like(arr, np.nan)
             poisoned.append(arr)
         return tree_to_bytes(jax.tree_util.tree_unflatten(treedef, poisoned))
+    if mode == SCALED_UPDATE:
+        # Adversarial amplification (round 18, Blanchard et al.): the
+        # client's REAL trained weights x SCALE_FACTOR — every value
+        # finite, every shape exact, so sanitation ACCEPTS it and FedAvg
+        # averages it in. Only the health ledger's flush-time anomaly
+        # score (norm/cosine robust-z) can flag it — which is the claim
+        # the scaled-update drill pins.
+        import numpy as np
+
+        import jax
+
+        from fedcrack_tpu.compress import decode_frame, encode_frame, is_frame
+        from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
+
+        if is_frame(blob):
+            # Scale INSIDE the frame and re-frame with a fresh CRC: int8
+            # leaves amplify through their dequant scales, topk leaves
+            # through their float value region — the frame stays CRC-valid
+            # and decodes to the x-scaled reconstruction.
+            frame = decode_frame(blob)
+            leaves = [dict(spec) for spec in frame.leaves]
+            payload = bytearray(frame.payload)
+            off = 0
+            for spec in leaves:
+                shape = spec.get("shape") or []
+                n = 1
+                for s in shape:
+                    n *= int(s)
+                if spec.get("enc") == "int8":
+                    if spec.get("scales"):
+                        scales = np.frombuffer(spec["scales"], np.float32)
+                        spec["scales"] = (
+                            scales * np.float32(SCALE_FACTOR)
+                        ).tobytes()
+                    off += n
+                else:  # topk: k int32 indices then k float32 values
+                    k = int(spec.get("k", 0))
+                    if k:
+                        vals = np.frombuffer(
+                            payload[off + 4 * k : off + 8 * k], np.float32
+                        )
+                        payload[off + 4 * k : off + 8 * k] = (
+                            vals * np.float32(SCALE_FACTOR)
+                        ).tobytes()
+                    off += 8 * k
+            return encode_frame(
+                frame.codec, frame.round, frame.base_version, leaves,
+                bytes(payload),
+            )
+        tree = tree_from_bytes(blob)
+        scaled = jax.tree_util.tree_map(
+            lambda a: (
+                np.asarray(a) * np.asarray(SCALE_FACTOR, np.asarray(a).dtype)
+                if np.asarray(a).dtype.kind == "f"
+                else np.asarray(a)
+            ),
+            tree,
+        )
+        return tree_to_bytes(scaled)
     raise ValueError(f"not a payload poison: {mode}")
 
 
@@ -208,6 +273,7 @@ class ClientChaos:
             TRUNCATE_PAYLOAD,
             NAN_UPDATE,
             CORRUPT_COMPRESSED_FRAME,
+            SCALED_UPDATE,
         ):
             if self.plan.take(mode, client=cname, round=rnd) is not None:
                 msg.done.weights = _poison_weights(msg.done.weights, mode)

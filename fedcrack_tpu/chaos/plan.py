@@ -35,6 +35,12 @@ STALE_REPLAY = "stale_replay"                  # TrainDone re-tagged with round-
 # decode must reject it (checksum mismatch) before any reconstruction, and
 # the round must still reach quorum without the poisoned upload.
 CORRUPT_COMPRESSED_FRAME = "corrupt_compressed_frame"
+# Adversarially AMPLIFIED update (round 18, Blanchard et al.'s threat
+# model): the client's real trained weights scaled by a large finite
+# factor — shape-correct, fully finite, so it PASSES sanitation and is
+# averaged in; the health ledger's flush-time anomaly score is what flags
+# it (drilled by tools/chaos_drill.run_scaled_update_drill).
+SCALED_UPDATE = "scaled_update"
 
 # Mesh plane (driver hook; fedcrack_tpu.parallel.driver fault_injector).
 MESH_DEVICE_FAIL = "mesh_device_fail"          # round dispatch raises (preemption)
@@ -86,6 +92,7 @@ CLIENT_KINDS = frozenset(
         NAN_UPDATE,
         STALE_REPLAY,
         CORRUPT_COMPRESSED_FRAME,
+        SCALED_UPDATE,
     }
 )
 MESH_KINDS = frozenset({MESH_DEVICE_FAIL, MESH_NONFINITE})

@@ -41,6 +41,7 @@ def server_state_to_bytes(state: Any) -> bytes:
 
     from fedcrack_tpu.fed import buffered as _buffered
     from fedcrack_tpu.fed.serialization import tree_to_bytes
+    from fedcrack_tpu.health import ledger as _health_ledger
 
     opt_blob = None
     if state.server_opt_state is not None:
@@ -95,6 +96,11 @@ def server_state_to_bytes(state: Any) -> bytes:
         "base_blobs": {
             str(int(v)): b for v, b in sorted(state.base_blobs.items())
         },
+        # Per-client health ledger (round 18, health/ledger.py):
+        # canonically-sorted wire rows — the snapshot bytes stay a pure
+        # function of state, arrival order never leaks in. Absent in
+        # pre-round-18 snapshots (restores as empty).
+        "ledger": _health_ledger.ledger_to_wire(state.ledger),
     }
     return msgpack.packb(payload, use_bin_type=True)
 
@@ -107,6 +113,7 @@ def server_state_from_bytes(blob: bytes, config: Any) -> Any:
     from fedcrack_tpu.fed import buffered as _buffered
     from fedcrack_tpu.fed import rounds as R
     from fedcrack_tpu.fed.serialization import tree_from_bytes
+    from fedcrack_tpu.health import ledger as _health_ledger
 
     payload = msgpack.unpackb(blob, raw=False)
     if payload.get("format") != STATE_FORMAT:
@@ -173,6 +180,7 @@ def server_state_from_bytes(blob: bytes, config: Any) -> Any:
                 else {}
             )
         ),
+        ledger=_health_ledger.ledger_from_wire(payload.get("ledger", [])),
         server_opt_state=opt_state,
         # Monotonic clocks do not survive a process: re-arm on first event
         # (rounds._advance_time stamps round_started_at when RUNNING).

@@ -49,6 +49,7 @@ import jax
 
 from fedcrack_tpu.fed import rounds as R
 from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
+from fedcrack_tpu.health import ledger as _health_ledger
 
 MODE_SYNC = "sync"
 MODE_BUFFERED = "buffered"
@@ -109,9 +110,11 @@ def fold_buffer(buffer, template) -> tuple:
     discipline as ``decode_and_validate_update``): entries sorted by
     ``(cname, seq)``, decoded against ``template``, averaged with
     effective weight ``ns * staleness_weight``. Returns ``(avg_tree,
-    entries_sorted, counts, eff)`` — ``eff`` aligned with
-    ``entries_sorted``; the average is unweighted when every sample count
-    is zero (mirroring the sync barrier)."""
+    entries_sorted, counts, eff, trees)`` — ``eff`` and ``trees`` aligned
+    with ``entries_sorted`` (the decoded trees, so the flush-time health
+    scoring reuses this decode instead of paying a second one); the
+    average is unweighted when every sample count is zero (mirroring the
+    sync barrier)."""
     if not buffer:
         raise RuntimeError("fold of an empty buffer")
     entries = sorted(buffer, key=_entry_sort_key)
@@ -119,7 +122,7 @@ def fold_buffer(buffer, template) -> tuple:
     counts = [e["ns"] for e in entries]
     eff = [e["ns"] * e["weight"] for e in entries]
     weights = eff if any(c > 0 for c in counts) else None
-    return R.fedavg(trees, weights), entries, counts, eff
+    return R.fedavg(trees, weights), entries, counts, eff, trees
 
 
 # Decoded-base memo for the accept path: version -> (blob, tree). Every
@@ -189,6 +192,14 @@ class BufferedAggregator:
         never)."""
         cname, ns, now = event.cname, event.num_samples, event.now
         if cname not in state.cohort:
+            if cname in state.ledger:
+                state = state._replace(
+                    ledger=_health_ledger.record_offer(
+                        state.ledger, cname, outcome="rejected",
+                        reason_class="not_in_cohort",
+                        round=state.current_round,
+                    )
+                )
             return state, R.Reply(
                 status=R.REJECTED, config={"reason": "not in cohort"}
             )
@@ -209,6 +220,7 @@ class BufferedAggregator:
                 cname,
                 f"too stale: base version {base_version} is {staleness} "
                 f"behind (max_staleness={cfg.max_staleness})",
+                staleness=staleness,
             )
         base_blob = state.base_blobs.get(int(base_version))
         if base_blob is None:
@@ -218,7 +230,7 @@ class BufferedAggregator:
             return BufferedAggregator._resync(
                 state, cname, f"base version {base_version} no longer retained"
             )
-        blob, wire_len, codec_name, problem = R.decode_and_validate_update(
+        blob, wire_len, codec_name, problem, norm = R.decode_and_validate_update(
             event.blob,
             ns,
             template=state.template,
@@ -229,7 +241,15 @@ class BufferedAggregator:
         if problem is not None:
             rejected = dict(state.rejected)
             rejected[cname] = problem
-            state = state._replace(rejected=rejected)
+            state = state._replace(
+                rejected=rejected,
+                ledger=_health_ledger.record_offer(
+                    state.ledger, cname, outcome="rejected",
+                    reason_class="sanitation", num_samples=ns,
+                    wire_len=wire_len, round=state.current_round,
+                    staleness=staleness,
+                ),
+            )
             return state, R.Reply(
                 status=R.REJECTED,
                 config={"reason": f"update rejected: {problem}"},
@@ -246,7 +266,14 @@ class BufferedAggregator:
             "wire_len": int(wire_len),
             "codec": codec_name,
         }
-        state = state._replace(buffer=state.buffer + (entry,))
+        state = state._replace(
+            buffer=state.buffer + (entry,),
+            ledger=_health_ledger.record_offer(
+                state.ledger, cname, outcome="accepted", num_samples=ns,
+                wire_len=wire_len, round=state.current_round,
+                staleness=staleness, norm=norm,
+            ),
+        )
         if (
             state.phase == R.PHASE_RUNNING
             and len(state.buffer) >= cfg.buffer_k
@@ -268,13 +295,19 @@ class BufferedAggregator:
 
     @staticmethod
     def _resync(
-        state: R.ServerState, cname: str, reason: str
+        state: R.ServerState, cname: str, reason: str, staleness: int = 0
     ) -> tuple[R.ServerState, R.Reply]:
         """Record the refusal (observable forever, averaged never) and hand
         the sender the current global so it rejoins instead of dying."""
         rejected = dict(state.rejected)
         rejected[cname] = reason
-        state = state._replace(rejected=rejected)
+        state = state._replace(
+            rejected=rejected,
+            ledger=_health_ledger.record_offer(
+                state.ledger, cname, outcome="resync",
+                round=state.current_round, staleness=staleness,
+            ),
+        )
         state = BufferedAggregator.record_pull(state, cname)
         return state, R.Reply(
             status=R.NOT_WAIT,
@@ -308,7 +341,9 @@ class BufferedAggregator:
         """
         import numpy as np
 
-        avg, entries, counts, eff = fold_buffer(state.buffer, state.template)
+        avg, entries, counts, eff, trees = fold_buffer(
+            state.buffer, state.template
+        )
         mix = 1.0
         total_ns = float(sum(counts))
         if any(c > 0 for c in counts):
@@ -364,7 +399,18 @@ class BufferedAggregator:
             if new_version - v <= state.config.max_staleness
         }
         bases[new_version] = new_wire_blob or new_blob
+        # Health ledger (round 18): score this flush's geometry on the
+        # trees the fold already decoded, in the fold's own sorted order.
+        # The base is the CURRENT global for every entry — a uniform
+        # reference despite per-entry pull bases; norms at the gate kept
+        # the per-base geometry, this window scores cohort coherence.
+        new_ledger, _scores = _health_ledger.observe_flush(
+            state.ledger,
+            [(e["cname"], t) for e, t in zip(entries, trees)],
+            tree_from_bytes(state.global_blob, template=state.template),
+        )
         return state._replace(
+            ledger=new_ledger,
             global_blob=new_blob,
             wire_blob=new_wire_blob,
             current_round=new_round,

@@ -89,6 +89,7 @@ from fedcrack_tpu.fed.serialization import (
     tree_to_bytes,
     validate_update,
 )
+from fedcrack_tpu.health import ledger as _health_ledger
 
 # ---- status codes (reference vocabulary, §2.4) ----
 SW = "SW"                # enrolled in this session's cohort
@@ -240,6 +241,11 @@ class ServerState:
     pulled: Mapping[str, int] = dataclasses.field(default_factory=dict)
     buffer: tuple = ()
     base_blobs: Mapping[int, bytes] = dataclasses.field(default_factory=dict)
+    # Per-client health ledger (round 18, health/ledger.py): every gate
+    # verdict plus flush-time update geometry (norms, cosines, anomaly
+    # scores), rolling and bounded per client. Persists in the statefile;
+    # mutated only through the ledger module's pure helpers.
+    ledger: Mapping[str, dict] = dataclasses.field(default_factory=dict)
 
     @property
     def broadcast_blob(self) -> bytes:
@@ -285,7 +291,7 @@ def decode_and_validate_update(
     base_fn,
     base_version: int,
     sanitize: bool,
-) -> tuple[bytes, int, str, str | None]:
+) -> tuple[bytes, int, str, str | None, float | None]:
     """THE upload acceptance gate, shared by every aggregation tier
     (round 13): the root's ``transition`` and the edge aggregators in
     :mod:`fedcrack_tpu.fed.tree` route every ``TrainDone`` payload through
@@ -300,14 +306,19 @@ def decode_and_validate_update(
     surface, and a CRC-valid frame can still carry a poisoned trainer's
     NaNs). A raw blob is validated when ``sanitize`` is on.
 
-    Returns ``(decoded_blob, wire_len, codec_name, problem)`` — ``problem``
-    is the rejection reason (never aggregate) or None; on acceptance
-    ``decoded_blob`` is the full-tree msgpack bytes (re-serialized for a
-    frame, the original bytes for a raw upload).
+    Returns ``(decoded_blob, wire_len, codec_name, problem, norm)`` —
+    ``problem`` is the rejection reason (never aggregate) or None; on
+    acceptance ``decoded_blob`` is the full-tree msgpack bytes
+    (re-serialized for a frame, the original bytes for a raw upload) and
+    ``norm`` is the update's L2 distance to the base, computed here in the
+    same pass over the already-decoded tree (the health ledger's gate-time
+    geometry sample; None when nothing was decoded — raw uploads with
+    sanitation off — or on rejection).
     """
     wire_len = len(blob)
     codec_name = "null"
     problem = None
+    norm = None
     if wire_frames.is_frame(blob):
         if template is None:
             problem = "compressed frame rejected: server has no decode template"
@@ -329,6 +340,7 @@ def decode_and_validate_update(
                 problem = validate_update(tree, template)
                 if problem is None:
                     blob = tree_to_bytes(tree)
+                    norm = _health_ledger.update_norm(tree, base_fn())
         if problem is None and num_samples < 0:
             problem = f"negative sample count {num_samples}"
     elif sanitize:
@@ -336,7 +348,13 @@ def decode_and_validate_update(
             problem = f"negative sample count {num_samples}"
         elif template is not None:
             problem = validate_update(blob, template)
-    return blob, wire_len, codec_name, problem
+            if problem is None:
+                norm = _health_ledger.update_norm(
+                    tree_from_bytes(blob, template=template), base_fn()
+                )
+    if problem is not None:
+        norm = None
+    return blob, wire_len, codec_name, problem, norm
 
 
 def drop_log(state: ServerState, cname: str, title: str) -> ServerState:
@@ -567,7 +585,16 @@ def _aggregate(state: ServerState, now: float) -> ServerState:
         "cohort_size": len(state.cohort),
         "rejected": dict(state.rejected),
     }
+    # Health ledger (round 18): score this flush's update geometry — norm
+    # and cosine-to-cohort-mean per client, robust z vs the window — on the
+    # SAME decoded trees FedAvg just averaged (no second decode).
+    new_ledger, _scores = _health_ledger.observe_flush(
+        state.ledger,
+        list(zip(names, trees)),
+        _decoded_round_base(state),
+    )
     return state._replace(
+        ledger=new_ledger,
         global_blob=new_blob,
         wire_blob=new_wire_blob,
         current_round=new_round,
@@ -728,6 +755,15 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
 
                 return BufferedAggregator.offer(state, event)
             if cname not in state.cohort:
+                # Ledger-feed only for names we have already seen (an
+                # unknown-name flood must not grow the ledger unboundedly).
+                if cname in state.ledger:
+                    state = state._replace(
+                        ledger=_health_ledger.record_offer(
+                            state.ledger, cname, outcome="rejected",
+                            reason_class="not_in_cohort", round=rnd,
+                        )
+                    )
                 return state, Reply(
                     status=REJECTED, config={"reason": "not in cohort"}
                 )
@@ -742,7 +778,14 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
                 reason = f"stale round {rnd} (server at {state.current_round})"
                 rejected = dict(state.rejected)
                 rejected[cname] = reason
-                state = state._replace(rejected=rejected)
+                state = state._replace(
+                    rejected=rejected,
+                    ledger=_health_ledger.record_offer(
+                        state.ledger, cname, outcome="resync",
+                        num_samples=ns, round=rnd,
+                        staleness=state.current_round - rnd,
+                    ),
+                )
                 return state, Reply(
                     status=NOT_WAIT,
                     blob=state.broadcast_blob,
@@ -752,6 +795,12 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
                 # FUTURE round: a protocol violation no resync can explain —
                 # explicit rejection (fix #3; the reference returned None
                 # and crashed on encode).
+                state = state._replace(
+                    ledger=_health_ledger.record_offer(
+                        state.ledger, cname, outcome="rejected",
+                        reason_class="stale", round=rnd,
+                    )
+                )
                 return state, Reply(
                     status=REJECTED,
                     config={
@@ -772,7 +821,7 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
             # step; an operator who needs multi-GB uploads sanitized
             # off-thread should gate at the transport instead. fedlint
             # COMP001 pins the frame decode to validate_update statically.
-            blob, wire_len, codec_name, problem = decode_and_validate_update(
+            blob, wire_len, codec_name, problem, norm = decode_and_validate_update(
                 blob,
                 ns,
                 template=state.template,
@@ -786,7 +835,14 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
                 # poisoned trainer must not silently keep federating.
                 rejected = dict(state.rejected)
                 rejected[cname] = problem
-                state = state._replace(rejected=rejected)
+                state = state._replace(
+                    rejected=rejected,
+                    ledger=_health_ledger.record_offer(
+                        state.ledger, cname, outcome="rejected",
+                        reason_class="sanitation", num_samples=ns,
+                        wire_len=wire_len, round=rnd,
+                    ),
+                )
                 return state, Reply(
                     status=REJECTED,
                     config={
@@ -805,7 +861,13 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
             wire[cname] = wire_len
             codecs = dict(state.codecs)
             codecs[cname] = codec_name
-            state = state._replace(received=received, wire_bytes=wire, codecs=codecs)
+            state = state._replace(
+                received=received, wire_bytes=wire, codecs=codecs,
+                ledger=_health_ledger.record_offer(
+                    state.ledger, cname, outcome="accepted", num_samples=ns,
+                    wire_len=wire_len, round=rnd, norm=norm,
+                ),
+            )
             if _barrier_met(state):
                 state = _aggregate(state, now)
                 status = FIN if state.phase == PHASE_FINISHED else RESP_ARY

@@ -46,6 +46,7 @@ from fedcrack_tpu.fed import rounds as R
 from fedcrack_tpu.fed.algorithms import fedavg, sample_cohort
 from fedcrack_tpu.fed.rounds import decode_and_validate_update, quorum_target
 from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
+from fedcrack_tpu.health import ledger as _health_ledger
 from fedcrack_tpu.ioutils import atomic_write_bytes
 from fedcrack_tpu.obs import spans as tracing
 from fedcrack_tpu.obs.registry import REGISTRY
@@ -166,6 +167,10 @@ class EdgeAggregator:
         self.received: dict[str, tuple[bytes, int]] = {}
         self.rejected: dict[str, str] = {}
         self.wire_bytes: dict[str, int] = {}
+        # Per-leaf health ledger (round 18): the edge feeds the SAME ledger
+        # shape as the root — every gate verdict plus flush-time geometry —
+        # and persists it in its statefile alongside the round state.
+        self.ledger: dict[str, dict] = {}
         # Observability the cohort-scale decision point reads: the most
         # decoded update blobs this edge ever held at once (must stay
         # <= leaf fan-in) and the wire bytes in/up.
@@ -308,7 +313,7 @@ class EdgeAggregator:
             return False, f"{cname} not in this edge's shard"
         if cname in self.received:
             return False, f"duplicate upload from {cname}"
-        decoded, wire_len, _codec, problem = decode_and_validate_update(
+        decoded, wire_len, _codec, problem, norm = decode_and_validate_update(
             blob,
             num_samples,
             template=self.template,
@@ -320,12 +325,21 @@ class EdgeAggregator:
         _edge_wire_counter().labels(direction="in").inc(wire_len)
         if problem is not None:
             self.rejected[cname] = problem
+            self.ledger = _health_ledger.record_offer(
+                self.ledger, cname, outcome="rejected",
+                reason_class="sanitation", num_samples=num_samples,
+                wire_len=wire_len, round=self.round,
+            )
             _edge_updates_counter().labels(result="rejected").inc()
             self._persist()
             return False, problem
         _edge_updates_counter().labels(result="accepted").inc()
         self.received[cname] = (decoded, int(num_samples))
         self.wire_bytes[cname] = wire_len
+        self.ledger = _health_ledger.record_offer(
+            self.ledger, cname, outcome="accepted", num_samples=num_samples,
+            wire_len=wire_len, round=self.round, norm=norm,
+        )
         self._stamp_trace(cname, trace_ctx)
         self.peak_resident_blobs = max(self.peak_resident_blobs, len(self.received))
         self._persist()
@@ -353,19 +367,26 @@ class EdgeAggregator:
         staleness = self.base_version - int(base_version)
         if staleness < 0:
             return self._refuse(
-                cname, f"future base version {base_version} (edge at {self.base_version})"
+                cname,
+                f"future base version {base_version} (edge at {self.base_version})",
+                reason_class="stale",
             )
         if staleness > self.max_staleness:
             return self._refuse(
                 cname,
                 f"too stale: base version {base_version} is {staleness} "
                 f"behind (max_staleness={self.max_staleness})",
+                reason_class="stale",
+                staleness=staleness,
             )
         if int(base_version) not in self.bases:
             return self._refuse(
-                cname, f"base version {base_version} no longer retained"
+                cname,
+                f"base version {base_version} no longer retained",
+                reason_class="stale",
+                staleness=staleness,
             )
-        decoded, wire_len, codec_name, problem = decode_and_validate_update(
+        decoded, wire_len, codec_name, problem, norm = decode_and_validate_update(
             blob,
             num_samples,
             template=self.template,
@@ -376,8 +397,17 @@ class EdgeAggregator:
         self.bytes_in += wire_len
         _edge_wire_counter().labels(direction="in").inc(wire_len)
         if problem is not None:
-            return self._refuse(cname, problem)
+            return self._refuse(
+                cname, problem, reason_class="sanitation",
+                num_samples=num_samples, wire_len=wire_len,
+                staleness=staleness,
+            )
         _edge_updates_counter().labels(result="accepted").inc()
+        self.ledger = _health_ledger.record_offer(
+            self.ledger, cname, outcome="accepted", num_samples=num_samples,
+            wire_len=wire_len, round=self.round, staleness=staleness,
+            norm=norm,
+        )
         self._stamp_trace(cname, trace_ctx)
         self.buffer.append(
             {
@@ -398,8 +428,22 @@ class EdgeAggregator:
         self._persist()
         return True, None
 
-    def _refuse(self, cname: str, reason: str) -> tuple[bool, str]:
+    def _refuse(
+        self,
+        cname: str,
+        reason: str,
+        *,
+        reason_class: str = "other",
+        num_samples: int = 0,
+        wire_len: int = 0,
+        staleness: int = 0,
+    ) -> tuple[bool, str]:
         self.rejected[cname] = reason
+        self.ledger = _health_ledger.record_offer(
+            self.ledger, cname, outcome="rejected", reason_class=reason_class,
+            num_samples=num_samples, wire_len=wire_len, round=self.round,
+            staleness=staleness,
+        )
         _edge_updates_counter().labels(result="rejected").inc()
         self._persist()
         return False, reason
@@ -427,8 +471,15 @@ class EdgeAggregator:
             raise RuntimeError("flush_partial is a buffered-mode call")
         if not self.buffer:
             raise RuntimeError(f"edge {self.edge_id}: flush of an empty buffer")
-        avg, entries, counts, eff = _buffered.fold_buffer(
+        avg, entries, counts, eff, trees = _buffered.fold_buffer(
             self.buffer, self.template
+        )
+        # Health ledger (round 18): score this flush's geometry on the
+        # fold's already-decoded trees against the current base.
+        self.ledger, _scores = _health_ledger.observe_flush(
+            self.ledger,
+            [(e["cname"], t) for e, t in zip(entries, trees)],
+            self._decoded_base(),
         )
         total_eff = float(sum(eff))
         total_ns = float(sum(counts))
@@ -493,6 +544,9 @@ class EdgeAggregator:
         ]
         counts = [self.received[n][1] for n in names]
         weights = counts if any(c > 0 for c in counts) else None
+        self.ledger, _scores = _health_ledger.observe_flush(
+            self.ledger, list(zip(names, trees)), self._decoded_base()
+        )
         avg = fedavg(trees, weights)
         total = int(sum(counts))
         blob = tree_to_bytes(avg)
@@ -566,6 +620,9 @@ class EdgeAggregator:
                 )
             ],
             "bases": {str(int(v)): b for v, b in sorted(self.bases.items())},
+            # Health ledger (round 18): canonically-sorted wire rows, the
+            # same codec the server statefile uses. Absent pre-round-18.
+            "ledger": _health_ledger.ledger_to_wire(self.ledger),
         }
         atomic_write_bytes(self.state_path, msgpack.packb(payload, use_bin_type=True))
 
@@ -639,6 +696,9 @@ class EdgeAggregator:
                 int(v): bytes(b)
                 for v, b in payload.get("bases", {}).items()
             }
+            edge.ledger = _health_ledger.ledger_from_wire(
+                payload.get("ledger", [])
+            )
             edge.peak_resident_blobs = max(len(edge.received), len(edge.buffer))
             return edge
         except Exception:

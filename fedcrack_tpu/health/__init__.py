@@ -1,0 +1,32 @@
+"""Federation health plane (round 18): model/cohort quality observability.
+
+Rounds 15-16 built the *operational* telemetry (latency, bytes, versions,
+recompiles); nothing observed whether the MODEL or the COHORT is healthy. A
+sanitation-passing but adversarially-scaled update averages in silently, a
+global version that regresses held-out IoU hot-swaps into the fleet
+unnoticed, and the serve plane had no drift signal for the serve->train
+flywheel. This package is the quality layer over the same pipes:
+
+- :mod:`fedcrack_tpu.health.ledger` — the per-client update ledger fed by
+  every aggregation tier's acceptance gate, plus robust (median/MAD)
+  anomaly scoring over update geometry at each flush.
+- :mod:`fedcrack_tpu.health.canary` — pinned held-out probe evaluation of
+  every new global version, off the serving hot path.
+- :mod:`fedcrack_tpu.health.drift` — per-bucket serve-input/prediction
+  profiles compared via population stability index against a frozen
+  install-time reference.
+"""
+
+from fedcrack_tpu.health.ledger import (  # noqa: F401
+    ANOMALY_ALERT,
+    LEDGER_WINDOW,
+    cohort_geometry,
+    export_anomaly_metrics,
+    ledger_from_wire,
+    ledger_to_wire,
+    new_record,
+    observe_flush,
+    record_offer,
+    update_norm,
+    write_ledger_jsonl,
+)

@@ -1,0 +1,152 @@
+"""Canary evaluation of new global versions (round 18).
+
+Every hot swap installs weights the serving path will trust completely; the
+canary is the held-out quality check that runs OFF that path, against the
+same pinned probe oracle the int8 quant gate uses (``serve/quant.py``):
+seeded synthetic crack batches at every bucket size, masks compared by IoU.
+
+The REFERENCE is the first version this evaluator sees (typically the boot
+weights, installed before traffic): every later version's probe masks are
+IoU'd against the reference masks, and the min over buckets becomes the
+``model_canary_iou_ratio`` gauge — a time-series a watchdog regression rule
+(``configs/slo_health.json``) can bound, with the standard breach contract
+(flight-recorder dump, exit 3). A poisoned flush that drags the global
+average (chaos ``SCALED_UPDATE``) shows up here as an IoU cliff even though
+every averaged update individually passed sanitation.
+
+Contract with the swap path (test-pinned): :meth:`evaluate` is called from
+the version manager's POLL thread after the pointer flip, wrapped so a
+raising canary can never fail or block an install — the serving path never
+pays for it, and ``recompiles_since_warmup`` stays 0 (probe batches reuse
+the engine's compiled bucket programs at ``max_batch``).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from fedcrack_tpu.obs import flight
+from fedcrack_tpu.obs import spans as tracing
+from fedcrack_tpu.obs.registry import REGISTRY
+
+log = logging.getLogger("fedcrack.health.canary")
+
+
+class CanaryEvaluator:
+    """Pinned probe-set IoU tracking across installed global versions.
+
+    Deterministic: the probe batches are seeded (``probe_seed``), buckets
+    are evaluated in the engine's fixed bucket order, and the reference is
+    whatever version is evaluated first — same install sequence, same
+    history. Not thread-safe against concurrent evaluate() calls; the
+    version manager's single poll thread is the intended caller.
+    """
+
+    def __init__(
+        self,
+        engine: Any,
+        *,
+        probe_batch: int | None = None,
+        probe_seed: int | None = None,
+        history_cap: int = 256,
+        registry: Any = None,
+        metrics: Any = None,
+    ):
+        cfg = engine.serve_config
+        self.engine = engine
+        self.probe_batch = (
+            cfg.quant_probe_batch if probe_batch is None else int(probe_batch)
+        )
+        self.probe_seed = (
+            cfg.quant_probe_seed if probe_seed is None else int(probe_seed)
+        )
+        self._history_cap = history_cap
+        self._registry = registry if registry is not None else REGISTRY
+        self._metrics = metrics
+        self.reference_version: int | None = None
+        self._reference_probs: dict[int, Any] = {}
+        self.history: list[dict] = []
+        self.last: dict | None = None
+
+    def evaluate(self, version: int, device_variables: Any) -> dict:
+        """Probe one installed version against the pinned reference.
+
+        ``device_variables`` is the already-prepared payload the swap
+        installed (plain tree or QuantizedVariables — the engine routes).
+        Returns the eval record; also appends it to ``history``, sets the
+        gauge, emits one ``health.canary`` span joined to the version's
+        flush lineage, and feeds the flight ring."""
+        from fedcrack_tpu.serve.quant import mask_iou, probe_images
+
+        version = int(version)
+        fctx = tracing.flush_context(version)
+        with tracing.span(
+            "health.canary",
+            trace=fctx.trace,
+            remote_parent=fctx.to_wire(),
+            version=version,
+        ) as span_handle:
+            per_bucket: dict[int, float] = {}
+            is_reference = self.reference_version is None
+            for size in self.engine.bucket_sizes:
+                batch = probe_images(
+                    size,
+                    min(self.probe_batch, self.engine.max_batch),
+                    self.probe_seed,
+                )
+                probs = self.engine.predict_bucket(device_variables, batch)
+                if is_reference:
+                    self._reference_probs[size] = probs
+                    per_bucket[size] = 1.0
+                else:
+                    per_bucket[size] = mask_iou(
+                        self._reference_probs[size], probs
+                    )
+            if is_reference:
+                self.reference_version = version
+            iou = min(per_bucket.values())
+            if span_handle is not None:
+                span_handle.set(iou=round(iou, 6), reference=is_reference)
+        self._registry.gauge(
+            "model_canary_iou_ratio",
+            "min-over-buckets mask IoU of the installed global version vs "
+            "the pinned canary reference on the seeded probe set (1.0 = "
+            "identical masks; a regression rule in configs/slo_health.json "
+            "bounds it)",
+        ).set(iou)
+        record = {
+            "version": version,
+            "iou": round(iou, 6),
+            "per_bucket": {str(k): round(v, 6) for k, v in per_bucket.items()},
+            "reference_version": int(self.reference_version),
+            "probe_batch": self.probe_batch,
+            "probe_seed": self.probe_seed,
+        }
+        self.history.append(record)
+        del self.history[: max(0, len(self.history) - self._history_cap)]
+        self.last = record
+        flight.note(
+            "health.canary", version=version, iou=record["iou"],
+            reference_version=record["reference_version"],
+        )
+        if self._metrics is not None:
+            self._metrics.log("canary_eval", **record)
+        log.info(
+            "canary eval v%d: iou=%.4f (reference v%d)",
+            version, iou, self.reference_version,
+        )
+        return record
+
+    def audit(self) -> dict:
+        """The end-of-soak 'canary steady' verdict: every eval finite in
+        [0, 1] (NOT an IoU floor — tiny randomly-initialized soak models
+        produce unstable masks; thresholds belong to the watchdog rules an
+        operator arms deliberately)."""
+        ious = [h["iou"] for h in self.history]
+        return {
+            "evals": len(self.history),
+            "reference_version": self.reference_version,
+            "min_iou": min(ious) if ious else None,
+            "all_finite_unit": all(0.0 <= i <= 1.0 for i in ious),
+        }

@@ -1,0 +1,249 @@
+"""Serve-side input/prediction drift detection (round 18).
+
+The serve->train flywheel needs a signal saying "what the fleet is seeing
+no longer looks like what the model was trained/validated on". This module
+profiles served traffic per bucket — input intensity distribution (plus
+scalar mean/std), prediction confidence and entropy histograms, and the
+``tools/quantify.py`` contour-derived crack-fraction distribution — and
+compares a live profile against a FROZEN reference captured at install
+time via the population stability index:
+
+    PSI = sum_i (p_i - q_i) * ln(p_i / q_i)
+
+over eps-smoothed bin fractions (fixed bins on [0, 1], so two profiles are
+always comparable). The usual reading: < 0.1 stable, 0.1-0.25 drifting,
+> 0.25 shifted — but the number is exported per (bucket, signal) as the
+``serve_drift_psi_ratio`` gauge and thresholds belong to watchdog rules.
+
+Everything is OFF the serving hot path: the monitor consumes request
+results AFTER their futures resolve (the soak's load loop, a sidecar, or a
+batch job), never inside the batcher. Deterministic: fixed bin edges,
+accumulation is order-independent (counts and sums), outputs rounded.
+
+The contour stats reuse :func:`fedcrack_tpu.tools.quantify.quantify_mask`,
+whose cv2 import is gated — without OpenCV the crack_fraction signal is
+simply absent from profiles and comparisons (never a crash, never a fake
+zero).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any, Mapping
+
+import numpy as np
+
+N_BINS = 10
+_EDGES = np.linspace(0.0, 1.0, N_BINS + 1)
+# Histogram signals a profile may carry (crack_fraction only with cv2).
+SIGNALS = ("input", "confidence", "entropy", "crack_fraction")
+
+
+def _hist(values: np.ndarray) -> list[int]:
+    """Fixed-bin counts over [0, 1]; values are clipped in (drift past the
+    domain must still land in the edge bins, not vanish)."""
+    clipped = np.clip(np.asarray(values, np.float64).ravel(), 0.0, 1.0)
+    counts, _ = np.histogram(clipped, bins=_EDGES)
+    return [int(c) for c in counts]
+
+
+def psi(
+    ref_counts: Any, cur_counts: Any, eps: float = 1e-4
+) -> float:
+    """Population stability index between two same-length count vectors.
+
+    Closed form over eps-smoothed fractions: both distributions are
+    normalized to sum 1 AFTER adding ``eps`` per bin, so empty bins never
+    divide by zero and PSI(x, x) == 0 exactly."""
+    ref = np.asarray(ref_counts, np.float64)
+    cur = np.asarray(cur_counts, np.float64)
+    if ref.shape != cur.shape:
+        raise ValueError(f"bin count mismatch: {ref.shape} vs {cur.shape}")
+    p = (ref + eps) / float(np.sum(ref + eps))
+    q = (cur + eps) / float(np.sum(cur + eps))
+    return round(float(np.sum((q - p) * np.log(q / p))), 6)
+
+
+def _crack_fractions(probs: np.ndarray) -> list[float] | None:
+    """Per-image contour-derived crack fraction via tools/quantify.py, or
+    None when OpenCV is unavailable (the signal is then omitted)."""
+    try:
+        from fedcrack_tpu.tools.quantify import quantify_mask
+    except Exception:
+        return None
+    out = []
+    try:
+        for img_probs in probs:
+            mask_u8 = (
+                np.clip(np.asarray(img_probs, np.float32), 0.0, 1.0) * 255.0
+            ).astype(np.uint8)
+            stats = quantify_mask(mask_u8[..., 0] if mask_u8.ndim == 3 else mask_u8)
+            out.append(float(stats.crack_fraction))
+    except Exception:
+        # quantify_mask imports cv2 lazily; ImportError surfaces here.
+        return None
+    return out
+
+
+class DriftMonitor:
+    """Accumulates per-bucket traffic profiles; compares against a frozen
+    reference profile.
+
+    Thread-safety: ``observe`` does plain adds on python ints/lists under
+    no lock — call it from ONE consumer (the soak's load loop resolves
+    futures in its own thread; that thread observes)."""
+
+    def __init__(self, reference: Mapping | None = None):
+        self.reference = dict(reference) if reference else None
+        self._buckets: dict[int, dict] = {}
+
+    @staticmethod
+    def _empty_bucket() -> dict:
+        return {
+            "n_images": 0,
+            "input_sum": 0.0,
+            "input_sumsq": 0.0,
+            "input_n": 0,
+            "hist": {s: [0] * N_BINS for s in SIGNALS if s != "crack_fraction"},
+            "crack_hist": None,  # [0]*N_BINS once cv2 produced a sample
+        }
+
+    def observe(self, images_u8: np.ndarray, probs: np.ndarray) -> None:
+        """Fold one answered request/batch into the live profile.
+
+        ``images_u8``: [B, S, S, 3] (or [S, S, 3]) uint8 inputs;
+        ``probs``: matching [B, S, S, 1] (or [S, S, 1]) float probabilities.
+        Bucket key is the spatial size S."""
+        images = np.asarray(images_u8)
+        p = np.asarray(probs, np.float32)
+        if images.ndim == 3:
+            images = images[None]
+        if p.ndim == 3:
+            p = p[None]
+        size = int(images.shape[1])
+        b = self._buckets.setdefault(size, self._empty_bucket())
+        x = images.astype(np.float64) / 255.0
+        b["n_images"] += int(images.shape[0])
+        b["input_sum"] += float(np.sum(x))
+        b["input_sumsq"] += float(np.sum(x * x))
+        b["input_n"] += int(x.size)
+        pc = np.clip(p, 1e-7, 1.0 - 1e-7)
+        confidence = np.maximum(pc, 1.0 - pc)
+        # Bernoulli entropy normalized to [0, 1] by ln 2.
+        entropy = -(
+            pc * np.log(pc) + (1.0 - pc) * np.log(1.0 - pc)
+        ) / math.log(2.0)
+        for signal, values in (
+            ("input", x), ("confidence", confidence), ("entropy", entropy)
+        ):
+            counts = _hist(values)
+            b["hist"][signal] = [
+                a + c for a, c in zip(b["hist"][signal], counts)
+            ]
+        fractions = _crack_fractions(p)
+        if fractions is not None:
+            if b["crack_hist"] is None:
+                b["crack_hist"] = [0] * N_BINS
+            counts = _hist(np.asarray(fractions))
+            b["crack_hist"] = [a + c for a, c in zip(b["crack_hist"], counts)]
+
+    # ---- profiles ----
+
+    def profile(self) -> dict:
+        """The canonical (sorted, rounded) profile dict — JSON-safe, what
+        the statefile-adjacent artifacts persist and PSI compares."""
+        buckets = {}
+        for size in sorted(self._buckets):
+            b = self._buckets[size]
+            n = max(1, b["input_n"])
+            mean = b["input_sum"] / n
+            var = max(0.0, b["input_sumsq"] / n - mean * mean)
+            hist = {s: list(b["hist"][s]) for s in sorted(b["hist"])}
+            if b["crack_hist"] is not None:
+                hist["crack_fraction"] = list(b["crack_hist"])
+            buckets[str(size)] = {
+                "n_images": b["n_images"],
+                "input_mean": round(mean, 6),
+                "input_std": round(math.sqrt(var), 6),
+                "hist": hist,
+            }
+        return {"bins": N_BINS, "buckets": buckets}
+
+    @classmethod
+    def capture_reference(
+        cls, engine: Any, device_variables: Any, *, n: int | None = None,
+        seed: int | None = None,
+    ) -> dict:
+        """The frozen install-time reference: the pinned probe set (same
+        oracle as the canary/quant gate) pushed through the engine at every
+        bucket size, profiled once. Pure function of (weights, seed)."""
+        from fedcrack_tpu.serve.quant import probe_images
+
+        cfg = engine.serve_config
+        n = cfg.quant_probe_batch if n is None else int(n)
+        seed = cfg.quant_probe_seed if seed is None else int(seed)
+        monitor = cls()
+        for size in engine.bucket_sizes:
+            batch = probe_images(size, min(n, engine.max_batch), seed)
+            probs = engine.predict_bucket(device_variables, batch)
+            monitor.observe(batch, probs)
+        return monitor.profile()
+
+    def compare(self, reference: Mapping | None = None) -> dict:
+        """Per-(bucket, signal) PSI of the live profile vs the reference.
+        Only (bucket, signal) pairs present in BOTH profiles compare —
+        missing traffic or a cv2-less crack signal is absence, not drift.
+        Returns {'<bucket>/<signal>': psi}."""
+        ref = reference if reference is not None else self.reference
+        if not ref:
+            return {}
+        current = self.profile()
+        out: dict[str, float] = {}
+        for size in sorted(current["buckets"]):
+            if size not in ref.get("buckets", {}):
+                continue
+            cur_hist = current["buckets"][size]["hist"]
+            ref_hist = ref["buckets"][size]["hist"]
+            for signal in sorted(set(cur_hist) & set(ref_hist)):
+                out[f"{size}/{signal}"] = psi(
+                    ref_hist[signal], cur_hist[signal]
+                )
+        return out
+
+
+def export_drift_metrics(psis: Mapping[str, float], registry=None) -> None:
+    """Per-(bucket, signal) PSI gauges — cardinality bounded by
+    construction (buckets x 4 signals)."""
+    from fedcrack_tpu.obs.registry import REGISTRY
+
+    reg = registry if registry is not None else REGISTRY
+    gauge = reg.gauge(
+        "serve_drift_psi_ratio",
+        "population stability index of live serve traffic vs the frozen "
+        "install-time reference profile, per (bucket, signal); < 0.1 "
+        "stable, > 0.25 shifted",
+        labels=("bucket", "signal"),
+    )
+    for key in sorted(psis):
+        bucket, signal = key.split("/", 1)
+        gauge.labels(bucket=bucket, signal=signal).set(float(psis[key]))
+
+
+def write_drift_json(
+    path: str, *, reference: Mapping | None, current: Mapping | None,
+    psis: Mapping[str, float] | None,
+) -> None:
+    """The soak's drift artifact: reference + live profile + comparison in
+    one deterministic JSON document (sorted keys, no timestamps)."""
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    doc = {
+        "reference": dict(reference) if reference else None,
+        "current": dict(current) if current else None,
+        "psi": {k: float(v) for k, v in (psis or {}).items()},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")

@@ -112,6 +112,7 @@ class FleetVersionManager:
         poll_s: float | None = None,
         template: Any | None = None,
         metrics: Any | None = None,
+        canary: Any | None = None,
     ):
         self.serve_config = serve_config
         self._watcher = WeightSourceWatcher(
@@ -119,6 +120,9 @@ class FleetVersionManager:
         )
         self._poll_s = poll_s if poll_s is not None else serve_config.swap_poll_s
         self._metrics = metrics
+        # Canary evaluator (round 18): probed at the install tail after the
+        # commit barrier, off the serving path; failures never fail a swap.
+        self.canary = canary
         self._lock = make_lock("serve.fleet.snapshot")
         self._replicas: list[Replica] = []
         self._slots: list[tuple[int, Any]] = []
@@ -312,6 +316,17 @@ class FleetVersionManager:
         )
         if self._metrics is not None:
             self._metrics.log("serve_fleet_swap", **record)
+        if self.canary is not None:
+            # First committed payload: every replica serves the same
+            # version, so one probe pass is the fleet's canary verdict.
+            payload = next((p for p in payloads if p is not None), None)
+            if payload is not None:
+                try:
+                    self.canary.evaluate(version, payload)
+                except Exception:
+                    log.exception(
+                        "canary eval failed for v%d (swap unaffected)", version
+                    )
         return True
 
     # ---- polling lifecycle (same shape as the r10 manager) ----

@@ -194,6 +194,7 @@ class ModelVersionManager:
         poll_s: float = 2.0,
         template: Any | None = None,
         metrics: Any | None = None,
+        canary: Any | None = None,
     ):
         self.engine = engine
         self._watcher = WeightSourceWatcher(
@@ -201,6 +202,10 @@ class ModelVersionManager:
         )
         self._poll_s = poll_s
         self._metrics = metrics
+        # Canary evaluator (round 18, health/canary.py): probed at the TAIL
+        # of install(), in the poll thread, after the pointer flip — a
+        # raising canary can never fail or block a swap (test-pinned).
+        self.canary = canary
         self._lock = make_lock("serve.hot_swap.snapshot")
         self._current = (int(initial_version), engine.prepare(initial_variables))
         # Swap wire contexts by installed version (round 16): the batcher
@@ -318,6 +323,15 @@ class ModelVersionManager:
                  current_version, version, load_ms)
         if self._metrics is not None:
             self._metrics.log("serve_swap", **record)
+        if self.canary is not None:
+            # After the flip, still in the poll thread: the serving path
+            # already moved on — the probe set reuses the engine's compiled
+            # bucket programs, so no recompile and no swap-path stall.
+            try:
+                self.canary.evaluate(version, device_variables)
+            except Exception:
+                log.exception("canary eval failed for v%d (swap unaffected)",
+                              version)
         return True
 
     # ---- lifecycle ----

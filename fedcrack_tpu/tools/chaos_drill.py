@@ -837,6 +837,189 @@ def run_replica_crash_drill() -> dict:
         fleet.close()
 
 
+def run_scaled_update_drill() -> dict:
+    """SCALED_UPDATE drill (round 18, Blanchard et al.'s threat model): an
+    adversarially AMPLIFIED update — the client's real trained weights
+    scaled by a large finite factor, shape-correct and fully finite — is
+    ACCEPTED by sanitation and averaged into the global. The drill pins the
+    two-layer detection story the health plane exists for:
+
+    Part 1 (ledger): a 3-client sync round where client c uploads its
+    update poisoned by ``_poison_weights(..., SCALED_UPDATE)`` (scheduled
+    and consumed through a chaos FaultPlan so the artifact proves it
+    fired). The server ACCEPTS it — same status as the honest clients, c
+    lands in the round's ``clients`` history — and FedAvg drags the global
+    by orders of magnitude; but the flush-time robust z-score in
+    ``state.ledger`` flags c past ANOMALY_ALERT while the honest clients
+    stay well below.
+
+    Part 2 (canary → watchdog): a tiny ResUNet serve stack evaluates the
+    canary reference on the boot weights, then hot-swaps in the dragged
+    global (the boot weights scaled by the same FedAvg drag factor part 1
+    produced). The pinned-probe IoU cliffs, the armed
+    ``configs/slo_health.json`` rules breach on BOTH signals (canary IoU
+    floor + anomaly ceiling over part 1's exported ledger), the flight
+    ring dumps, and the artifact records the ``BREACH_EXIT`` (3) contract.
+    """
+    import jax
+
+    from fedcrack_tpu.chaos import inject
+    from fedcrack_tpu.chaos.inject import _poison_weights
+    from fedcrack_tpu.chaos.plan import SCALED_UPDATE, Fault, FaultPlan
+    from fedcrack_tpu.configs import ModelConfig, ServeConfig
+    from fedcrack_tpu.health import ledger as health_ledger
+    from fedcrack_tpu.health.canary import CanaryEvaluator
+    from fedcrack_tpu.models.resunet import init_variables
+    from fedcrack_tpu.obs import flight
+    from fedcrack_tpu.obs.registry import MetricsRegistry
+    from fedcrack_tpu.obs.watchdog import BREACH_EXIT, Watchdog, load_rules
+    from fedcrack_tpu.serve.engine import InferenceEngine, watch_recompiles
+    from fedcrack_tpu.serve.hot_swap import ModelVersionManager
+    from fedcrack_tpu.transport import transport_pb2 as pb
+    from fedcrack_tpu.transport.service import FedServer, ServerThread
+
+    # ---- part 1: sanitation accepts, the ledger flags ----
+    plan = FaultPlan([Fault(kind=SCALED_UPDATE, round=1, client="c")])
+    cfg = FedConfig(
+        max_rounds=1,
+        cohort_size=3,
+        registration_window_s=5.0,
+        round_deadline_s=60.0,
+        port=0,
+    )
+    server = FedServer(cfg, _vars(0.0), tick_period_s=0.02)
+    t0 = time.perf_counter()
+    with ServerThread(server) as st:
+        channel, call = _raw_caller(st.port)
+        for c in ("a", "b", "c"):
+            assert call(_ready(c)).status == R.SW
+        # The poisoned upload lands FIRST: its accept status (RESP_ACY)
+        # cannot be confused with a round-closing reply.
+        fault = plan.take(SCALED_UPDATE, client="c", round=1)
+        assert fault is not None
+        poisoned = _poison_weights(tree_to_bytes(_vars(1.1)), SCALED_UPDATE)
+        msg = pb.ClientMessage(cname="c")
+        msg.done.round = 1
+        msg.done.weights = poisoned
+        msg.done.sample_count = 10
+        rep_c = call(msg)
+        rep_a = call(_done("a", 1, 1.0, 10))
+        rep_b = call(_done("b", 1, 1.2, 10))
+        channel.close()
+        state = st.state
+    entry = state.history[0] if state.history else {}
+    # Equal sample counts: the dragged global is the plain mean
+    # (1.0 + 1.2 + 1.1 * SCALE_FACTOR) / 3.
+    got_avg = float(
+        np.mean(tree_from_bytes(rep_b.weights)["params"]["w"])
+    )
+    drag = (1.0 + 1.2 + 1.1 * inject.SCALE_FACTOR) / 3.0
+    scores = {
+        name: state.ledger.get(name, {}).get("anomaly", 0.0)
+        for name in ("a", "b", "c")
+    }
+    ledger_part = {
+        "fault_fired": fault.kind,
+        "poisoned_accepted": rep_c.status == R.RESP_ACY,
+        "honest_accepted": rep_a.status == R.RESP_ACY
+        and rep_b.status in (R.RESP_ARY, R.FIN),
+        "poisoned_in_history_clients": entry.get("clients") == ["a", "b", "c"],
+        "nothing_rejected": entry.get("rejected", {}) == {},
+        "global_dragged_avg": round(got_avg, 4),
+        "global_drag_matches_fedavg": bool(
+            np.isclose(got_avg, drag, rtol=1e-5)
+        ),
+        "anomaly_scores": {k: round(v, 3) for k, v in scores.items()},
+        "alert_threshold": health_ledger.ANOMALY_ALERT,
+        "poisoned_flagged": scores["c"] >= health_ledger.ANOMALY_ALERT,
+        "honest_below_alert": max(scores["a"], scores["b"])
+        < health_ledger.ANOMALY_ALERT,
+        "flagged_flushes": state.ledger.get("c", {}).get("flags", 0),
+        "round_s": round(time.perf_counter() - t0, 4),
+    }
+
+    # ---- part 2: the dragged global cliffs the canary; watchdog fires ----
+    model_config = ModelConfig(
+        img_size=16, stem_features=4, encoder_features=(8,), decoder_features=(8, 4)
+    )
+    serve_config = ServeConfig(
+        bucket_sizes=(16,), max_batch=4, max_delay_ms=30.0, tile_overlap=4
+    )
+    v0 = init_variables(jax.random.key(0), model_config)
+    # The serving-side view of part 1's FedAvg: every float leaf dragged by
+    # the same mean-of-(1, 1, SCALE_FACTOR) factor a x1000 client lands on
+    # a 3-cohort — finite and shape-correct, so the swap path installs it.
+    leaf_drag = (1.0 + 1.0 + inject.SCALE_FACTOR) / 3.0
+    v_poisoned = jax.tree_util.tree_map(
+        lambda a: a * np.asarray(leaf_drag, np.asarray(a).dtype)
+        if np.asarray(a).dtype.kind == "f"
+        else a,
+        v0,
+    )
+    reg = MetricsRegistry()
+    engine = InferenceEngine(model_config, serve_config)
+    canary = CanaryEvaluator(engine, registry=reg)
+    manager = ModelVersionManager(
+        engine, v0, initial_version=0, canary=canary
+    )
+    engine.warmup(manager.snapshot()[1])
+    sentry = watch_recompiles(engine, registry=reg)
+    ref = canary.evaluate(0, manager.snapshot()[1])
+    installed = manager.install(1, v_poisoned)
+    assert installed and canary.last is not None
+    post = canary.last
+    recompiles = (
+        sum(sentry.deltas().values())
+        if type(sentry).supported(engine._fn)
+        else -1
+    )
+
+    # The armed health rules over ONE registry holding both signals: part
+    # 1's exported ledger anomaly gauges + the canary IoU time-series.
+    health_ledger.export_anomaly_metrics(state.ledger, registry=reg)
+    rules_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        os.pardir, os.pardir, "configs", "slo_health.json",
+    )
+    ring = flight.current()
+    installed_ring = False
+    if ring is None:  # direct invocation (tests); main() arms its own
+        ring = flight.install(path=os.path.join(
+            tempfile.gettempdir(), "scaled_update_drill.flight.json"
+        ), hooks=False)
+        installed_ring = True
+    try:
+        dumps_before = len(ring.dumps)
+        watchdog = Watchdog(load_rules(rules_path), registry=reg)
+        report = watchdog.enforce()
+        audit = watchdog.audit()
+        dumped = ring.dumps[dumps_before:]
+    finally:
+        if installed_ring:
+            flight.uninstall()
+    breached_rules = sorted({b["rule"] for b in report["breaches"]})
+    return {
+        "ledger": ledger_part,
+        "canary": {
+            "reference_iou": ref["iou"],
+            "poisoned_iou": post["iou"],
+            "iou_cliff": post["iou"] < 0.5 <= ref["iou"],
+            "swap_still_installed": installed,
+            "recompiles_since_warmup": recompiles,
+        },
+        "watchdog": {
+            "rules": audit["rules"],
+            "breached": breached_rules,
+            "both_signals_breached": breached_rules
+            == ["canary_iou_floor", "client_anomaly_ceiling"],
+            "flight_dumped": bool(dumped),
+            "flight_dump_reason": dumped[0]["reason"] if dumped else None,
+            "breach_exit_code": BREACH_EXIT,
+            "would_exit": BREACH_EXIT if audit["breaches"] else 0,
+        },
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--out", required=True)
@@ -859,6 +1042,7 @@ def main(argv=None) -> int:
             "straggler_storm": run_straggler_storm_drill(),
             "buffered_kill": run_buffered_kill_drill(),
             "replica_crash": run_replica_crash_drill(),
+            "scaled_update": run_scaled_update_drill(),
         }
     except BaseException:
         flight.dump("chaos drill failed")

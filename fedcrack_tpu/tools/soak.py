@@ -190,6 +190,13 @@ def run_soak(
     from fedcrack_tpu.transport.edge import raw_caller
     from fedcrack_tpu.transport.service import FedServer, ServerThread
 
+    from fedcrack_tpu.health import ledger as health_ledger
+    from fedcrack_tpu.health.canary import CanaryEvaluator
+    from fedcrack_tpu.health.drift import (
+        DriftMonitor,
+        export_drift_metrics,
+        write_drift_json,
+    )
     from fedcrack_tpu.obs import flight
     from fedcrack_tpu.obs.watchdog import Watchdog, load_rules
 
@@ -235,6 +242,12 @@ def run_soak(
         bucket_sizes=(16,), max_batch=4, max_delay_ms=5.0, tile_overlap=4
     )
     engine = InferenceEngine(model_config, serve_config)
+    serve_metrics = MetricsLogger(serve_metrics_path)
+    # Round 18 health plane: canary IoU per installed version (evaluated
+    # from the manager's poll thread AFTER each pointer flip — never on
+    # the serving path) + serve-side drift vs a frozen install-time
+    # reference profile (observed from the load loop's consumer thread).
+    canary = CanaryEvaluator(engine, metrics=serve_metrics)
     manager = ModelVersionManager(
         engine,
         template,
@@ -243,10 +256,17 @@ def run_soak(
         poll_s=0.15,
         template=template,
         metrics=None,
+        canary=canary,
     )
     engine.warmup(manager.snapshot()[1])
     recompile_sentry = watch_recompiles(engine)
-    serve_metrics = MetricsLogger(serve_metrics_path)
+    # The canary reference and the frozen drift profile both pin to the
+    # BOOT weights, after warmup (their probe batches reuse the compiled
+    # bucket programs; recompiles_since_warmup must stay 0 through them).
+    canary.evaluate(0, manager.snapshot()[1])
+    drift_monitor = DriftMonitor(
+        reference=DriftMonitor.capture_reference(engine, manager.snapshot()[1])
+    )
     batcher = MicroBatcher(engine, manager, metrics=serve_metrics)
     manager.start()  # hot-swap poller: the federation's statefile IS the feed
 
@@ -437,13 +457,17 @@ def run_soak(
             futures = []
             for _ in range(4):
                 img = rng.integers(0, 256, (16, 16, 3), dtype=np.uint8)
-                futures.append(batcher.submit(img, deadline_ms=250.0))
+                futures.append((img, batcher.submit(img, deadline_ms=250.0)))
                 load_stats["submitted"] += 1
-            for f in futures:
+            for img, f in futures:
                 try:
                     res = f.result(timeout=10.0)
                     load_stats["completed"] += 1
                     versions_seen.add(res.model_version)
+                    # Drift profiling happens HERE — after the future
+                    # resolved, on this consumer thread, never inside the
+                    # batcher (the hot path pays nothing for it).
+                    drift_monitor.observe(img, res.probs)
                 except Exception:
                     load_stats["failed"] += 1
             time.sleep(0.01)
@@ -605,6 +629,27 @@ def run_soak(
     statefile_ok = state_bytes == resaved_bytes
     leak = leak_sentry.summary()
     recompiles = sum(recompile_sentry.deltas().values())
+    # ---- round 18 health plane: artifacts + audit arms ----
+    ledger_path = os.path.join(base_dir, "ledger.jsonl")
+    canary_path = os.path.join(base_dir, "canary.json")
+    drift_path = os.path.join(base_dir, "drift.json")
+    health_ledger.write_ledger_jsonl(final_state.ledger, ledger_path)
+    canary_audit = canary.audit()
+    with open(canary_path, "w", encoding="utf-8") as f:
+        json.dump(
+            {"history": canary.history, "audit": canary_audit},
+            f, indent=1, sort_keys=True,
+        )
+        f.write("\n")
+    drift_psis = drift_monitor.compare()
+    export_drift_metrics(drift_psis)
+    write_drift_json(
+        drift_path,
+        reference=drift_monitor.reference,
+        current=drift_monitor.profile(),
+        psis=drift_psis,
+    )
+    ledger_conservation = health_ledger.conservation(final_state.ledger)
     audit = {
         "torn_versions": int(torn),
         "unpublished_served_versions": unpublished_served,
@@ -624,6 +669,18 @@ def run_soak(
         # Round 16: the machine-checked SLO verdict joins the audit — the
         # rule set replaces what used to be hand-coded per-harness checks.
         "watchdog_clean": bool(watchdog_audit["clean"]),
+        # Round 18: every gate verdict the chaos produced must be in the
+        # ledger exactly once (offers == accepted + rejected + resyncs,
+        # surviving the mid-soak kill→restart via the statefile), and
+        # every canary eval must be a finite unit-interval IoU.
+        "ledger_conservation": ledger_conservation,
+        "ledger_conserved": (
+            ledger_conservation["clients"] > 0
+            and not ledger_conservation["violations"]
+        ),
+        "canary_steady": (
+            canary_audit["evals"] > 0 and bool(canary_audit["all_finite_unit"])
+        ),
     }
     audit["clean"] = (
         audit["zero_torn_versions"]
@@ -634,6 +691,8 @@ def run_soak(
         and recompiles == 0
         and not hung
         and audit["watchdog_clean"]
+        and audit["ledger_conserved"]
+        and audit["canary_steady"]
     )
 
     def _sample(name: str, labels: dict | None = None):
@@ -710,6 +769,16 @@ def run_soak(
         "spans": {"total": len(span_records), "by_name": dict(sorted(span_names.items()))},
         "tracing": tracing_summary,
         "watchdog": watchdog_audit,
+        "health": {
+            "ledger_clients": ledger_conservation["clients"],
+            "flagged_clients": sorted(
+                name
+                for name, rec in final_state.ledger.items()
+                if rec.get("flags", 0)
+            ),
+            "canary": canary_audit,
+            "drift_psi": drift_psis,
+        },
         "audit": audit,
         "paths": {
             "metrics_dump": metrics_dump_path,
@@ -717,6 +786,9 @@ def run_soak(
             "statefile": state_path,
             "flight": flight_path,
             "stitched_trace": stitched_path,
+            "ledger": ledger_path,
+            "canary": canary_path,
+            "drift": drift_path,
         },
     }
     if not audit["clean"] and not any(

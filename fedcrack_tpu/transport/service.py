@@ -30,6 +30,7 @@ import grpc
 
 from fedcrack_tpu.configs import FedConfig
 from fedcrack_tpu.fed import rounds as R
+from fedcrack_tpu.health import ledger as _health_ledger
 from fedcrack_tpu.obs import flight
 from fedcrack_tpu.obs import spans as tracing
 from fedcrack_tpu.obs.registry import DEFAULT_VERSIONS_BUCKETS, REGISTRY
@@ -157,6 +158,14 @@ def observe_transition(
             )
             for s in staleness:
                 hist.observe(float(s))
+        # Health ledger export (round 18): every flush just re-scored the
+        # cohort's update geometry — publish the bounded anomaly gauges.
+        # Telemetry must never break the protocol: the export is pure dict
+        # math but the try keeps a malformed restored ledger non-fatal.
+        try:
+            _health_ledger.export_anomaly_metrics(state.ledger)
+        except Exception:
+            log.exception("anomaly metric export failed (non-fatal)")
 
 SERVICE_NAME = "fedcrack.FedControl"
 METHOD = "Session"

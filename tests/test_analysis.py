@@ -373,6 +373,56 @@ def test_obs002_span_name_dotted_literal():
     assert "OBS002" not in rule_ids(lint("soup.span('html')\n"))
 
 
+# ---- federation-health pack ----
+
+
+def test_health001_client_label_outside_chokepoint():
+    """HEALTH001 (round 18): a metric family labeled by a client axis
+    mints one series per enrolled client — only health/ledger.py's bounded
+    export (client_label / MAX_CLIENT_LABELS + _overflow) may do that."""
+    bad = (
+        "from fedcrack_tpu.obs.registry import REGISTRY\n"
+        "REGISTRY.counter('fed_updates_total', 'per-client updates',\n"
+        "                 labels=('client',)).labels(client=cname).inc()\n"
+    )
+    assert "HEALTH001" in rule_ids(lint(bad))
+    # Every client-axis spelling is caught, on any metric kind / receiver
+    # alias the OBS001 idiom covers.
+    assert "HEALTH001" in rule_ids(
+        lint("reg.gauge('fed_norm_ratio', 'x', labels=('cname',))\n")
+    )
+    assert "HEALTH001" in rule_ids(
+        lint("registry.histogram('fed_lag_seconds', 'x',"
+             " labels=['round', 'client_id'])\n")
+    )
+    # Bounded, non-client label axes stay fine.
+    good = (
+        "from fedcrack_tpu.obs.registry import REGISTRY\n"
+        "REGISTRY.counter('fed_updates_total', 'x', labels=('result',))\n"
+        "REGISTRY.gauge('serve_drift_psi_ratio', 'x',"
+        " labels=('bucket', 'signal'))\n"
+    )
+    assert "HEALTH001" not in rule_ids(lint(good))
+    # The chokepoint itself is exempt: its export path bounds cardinality.
+    inside = "reg.gauge('fed_client_anomaly_score_ratio', 'x', labels=('client',))\n"
+    assert "HEALTH001" not in rule_ids(
+        lint(inside, path="fedcrack_tpu/health/ledger.py")
+    )
+    assert "HEALTH001" in rule_ids(
+        lint(inside, path="fedcrack_tpu/fed/rounds.py")
+    )
+    # Non-registry receivers are not ours.
+    assert "HEALTH001" not in rule_ids(
+        lint("stats.counter('x_total', labels=('client',))\n")
+    )
+    # The live tree must route every client label through the chokepoint.
+    engine = LintEngine(rules=[rules_by_id()["HEALTH001"]])
+    modules = engine.load_modules(
+        [os.path.join(REPO, "fedcrack_tpu")], rel_to=REPO
+    )
+    assert engine.lint_modules(modules) == []
+
+
 # ---- lock-order pack (project scope: lint_modules, not lint_source) ----
 
 CYCLE_SRC = """\
