@@ -176,6 +176,7 @@ DETAIL_SCHEMA: dict = {
     "async_federation": dict,
     "observability": dict,
     "federation_health": dict,
+    "video_serving": dict,
 }
 # Typed keys of detail.observability (round 15): the concurrent mini-soak's
 # contract — the self-scrape must cover all five instrumented planes and
@@ -360,6 +361,24 @@ SERVE_FLEET_ARM_SCHEMA: dict = {
     "throughput_rps": (int, float, type(None)),
     "p50_ms": (int, float, type(None)),
     "p95_ms": (int, float, type(None)),
+}
+# Typed keys of detail.video_serving (round 19): the frame-coherent video
+# contract — the stateless-vs-cached-session A/B over a seeded
+# >=90%-overlap sequence, the per-frame byte-identity audit spanning a
+# live mid-sequence hot swap, the effective-throughput model
+# (img/s-equiv ~= stateless / changed-tile-fraction), the serve_stream_*
+# exposition check, and the StreamPredict gRPC smoke.
+VIDEO_SERVING_SCHEMA: dict = {
+    "frame": dict,
+    "stateless": dict,
+    "session": dict,
+    "effective_speedup": (int, float, type(None)),
+    "effective_img_per_s": (int, float, type(None)),
+    "speedup_target_met": bool,
+    "identity": dict,
+    "swap": dict,
+    "metrics_in_exposition": bool,
+    "grpc_smoke": (dict, type(None)),
 }
 # Per-point keys of detail.reference_scale.* and the per-arm dicts of
 # detail.segmented_pipeline.*: the staging/overlap decomposition contract.
@@ -565,6 +584,13 @@ def validate_detail(detail: dict) -> list:
                         f"cohort_scale.groups[{name!r}][{key!r}]: "
                         f"{type(point[key]).__name__}"
                     )
+    video = detail.get("video_serving")
+    if isinstance(video, dict) and "error" not in video:
+        for key, typs in VIDEO_SERVING_SCHEMA.items():
+            if key not in video:
+                bad.append(f"video_serving[{key!r}] missing")
+            elif not isinstance(video[key], typs):
+                bad.append(f"video_serving[{key!r}]: {type(video[key]).__name__}")
     return bad
 
 # Default sized from measured section costs on the TPU-tunnel host (round 4):
@@ -661,6 +687,24 @@ FLEET_REPLICAS = tuple(
 )
 FLEET_REQUESTS = int(os.environ.get("FEDCRACK_BENCH_FLEET_REQUESTS", "64"))
 FLEET_SHED_RATE = float(os.environ.get("FEDCRACK_BENCH_FLEET_SHED_RATE", "40"))
+
+# Video-serving section (round 19, detail.video_serving): the frame-coherent
+# session A/B — stateless predict_tiled vs the per-stream tile-cached
+# session over one seeded correlated sequence (>=90% frame-to-frame
+# overlap), per-frame byte-identity audit across a live mid-sequence hot
+# swap, the serve_stream_* registry exposition, and a StreamPredict gRPC
+# smoke via load_gen --profile video. Tiny weights: the section certifies
+# cache semantics and the effective-throughput model, not model quality.
+# "0" opts out. The default motion fraction (0.04 -> 8 changed rows at 192)
+# keeps the moving band inside ~2 of the 7 tile rows, so the steady-state
+# changed-tile fraction stays well under 1/3 and the >=3x effective-speedup
+# target is geometric, not timing-dependent.
+VIDEO = os.environ.get("FEDCRACK_BENCH_VIDEO", "1") == "1"
+VIDEO_FRAMES = int(os.environ.get("FEDCRACK_BENCH_VIDEO_FRAMES", "20"))
+VIDEO_FRAME_SIZE = int(os.environ.get("FEDCRACK_BENCH_VIDEO_FRAME_SIZE", "192"))
+VIDEO_MOTION_FRACTION = float(
+    os.environ.get("FEDCRACK_BENCH_VIDEO_MOTION_FRACTION", "0.04")
+)
 
 # Longer-round multiplier for the dispatch-correction fit; the two-point
 # slope needs the rounds to differ, so 2 is the floor.
@@ -2038,6 +2082,252 @@ def _bench_serving(device) -> dict:
     }
 
 
+def _bench_video_serving(device) -> dict:
+    """Frame-coherent video serving (round 19, detail.video_serving).
+
+    One seeded correlated sequence (a moving full-width noise band over a
+    static base frame, ``VIDEO_MOTION_FRACTION`` of the rows per step —
+    >=90% frame-to-frame overlap) served two ways on the SAME engine:
+
+    - **stateless**: ``engine.predict_tiled`` per frame — every tile
+      recomputed, the r10 contract and the byte-identity oracle;
+    - **session**: a ``StreamSession`` behind ``StreamSessionManager`` —
+      only tiles whose bytes changed run on device, keyed on
+      (model_version, content hash).
+
+    Mid-sequence a new model version installs through the SAME
+    ``ModelVersionManager`` the still path uses — the swap frame must be a
+    full re-run on the new version (old-version entries are unreachable by
+    key and purged), and its bytes must match stateless-under-v1. The
+    audit compares EVERY frame byte-for-byte against the per-version
+    stateless oracle, so ``identity.ok`` is the cached==stateless claim
+    measured, not assumed.
+
+    ``effective_speedup`` is tile accounting over steady-state frames
+    (frame 0 is by construction a cold full re-run):
+    tiles_total / tiles_computed ~= 1 / changed-tile-fraction — the
+    BASELINE.md effective-throughput model. It is seeded-deterministic;
+    the measured walls corroborate it but carry CPU timing noise.
+    """
+    from fedcrack_tpu.configs import ModelConfig, ServeConfig
+    from fedcrack_tpu.models.resunet import init_variables
+    from fedcrack_tpu.obs.registry import MetricsRegistry
+    from fedcrack_tpu.serve import (
+        InferenceEngine,
+        MicroBatcher,
+        ModelVersionManager,
+        ServeServer,
+        ServeServerThread,
+        ServeService,
+    )
+    from fedcrack_tpu.serve.stream import StreamSessionManager
+    from fedcrack_tpu.tools.load_gen import make_frame_sequence, run_load
+
+    dtype = "bfloat16" if getattr(device, "platform", "") == "tpu" else "float32"
+    size = VIDEO_FRAME_SIZE
+    serve_config = ServeConfig(
+        bucket_sizes=(16, 32),
+        max_batch=8,
+        max_delay_ms=5.0,
+        tile_overlap=4,
+        compute_dtype=dtype,
+        port=0,
+    )
+    model_config = ModelConfig(
+        img_size=max(serve_config.bucket_sizes),
+        stem_features=4,
+        encoder_features=(8,),
+        decoder_features=(8, 4),
+        compute_dtype=dtype,
+    )
+    var_v0 = init_variables(jax.random.key(SEED), model_config)
+    var_v1 = init_variables(jax.random.key(SEED + 1), model_config)
+
+    t0 = time.perf_counter()
+    engine = InferenceEngine(model_config, serve_config)
+    manager = ModelVersionManager(engine, var_v0, initial_version=0)
+    engine.warmup(manager.snapshot()[1])
+    warmup_s = time.perf_counter() - t0
+
+    n_frames = max(4, VIDEO_FRAMES)
+    frames = make_frame_sequence(n_frames, size, VIDEO_MOTION_FRACTION, seed=SEED)
+    band = int(round(VIDEO_MOTION_FRACTION * size))
+
+    # ---- stateless arm: the oracle AND the timing baseline ----
+    v0 = manager.snapshot()[1]
+    t0 = time.perf_counter()
+    stateless_probs = [engine.predict_tiled(v0, f) for f in frames]
+    stateless_wall = time.perf_counter() - t0
+    stateless_bytes = [np.asarray(p).tobytes() for p in stateless_probs]
+
+    # ---- session arm through the manager (metrics in a private registry,
+    # so the exposition check sees exactly this run's counters) ----
+    registry = MetricsRegistry()
+    smgr = StreamSessionManager(engine, manager, registry=registry)
+    session = smgr.open("bench", height=size, width=size)
+    swap_at = max(1, (2 * n_frames) // 3)
+    results = []
+    t0 = time.perf_counter()
+    for i, frame in enumerate(frames):
+        if i == swap_at:
+            # Direct install (pre-decoded weights), same rationale as the
+            # r10 serving section: the decode path is unit-tested and would
+            # only blur the timing.
+            manager.install(1, var_v1)
+        result = session.process_frame(frame)
+        smgr.record(result)
+        results.append(result)
+    session_wall = time.perf_counter() - t0
+
+    # ---- byte-identity audit (untimed): every frame vs the stateless
+    # oracle under the version the session actually pinned ----
+    v1 = manager.snapshot()[1]
+    mismatches = 0
+    swap_info: dict = {}
+    for i, (frame, result) in enumerate(zip(frames, results)):
+        if result.model_version == 0:
+            ref = stateless_bytes[i]
+        else:
+            ref = np.asarray(engine.predict_tiled(v1, frame)).tobytes()
+        identical = np.asarray(result.probs).tobytes() == ref
+        if not identical:
+            mismatches += 1
+        if i == swap_at:
+            swap_info = {
+                "frame": i,
+                "model_version": result.model_version,
+                "full_rerun_on_swap": result.tiles_computed == result.tiles_total,
+                "stale_entries_purged": result.evicted,
+                "identity_after_swap": bool(identical),
+            }
+
+    tiles_total = sum(r.tiles_total for r in results)
+    tiles_computed = sum(r.tiles_computed for r in results)
+    cache_hits = sum(r.cache_hits for r in results)
+    steady = results[1:]
+    st_total = sum(r.tiles_total for r in steady)
+    st_computed = sum(r.tiles_computed for r in steady)
+    effective_speedup = (st_total / st_computed) if st_computed else None
+    stateless_ips = n_frames / stateless_wall if stateless_wall > 0 else None
+    session_ips = n_frames / session_wall if session_wall > 0 else None
+    effective_ips = (
+        round(stateless_ips * effective_speedup, 3)
+        if stateless_ips and effective_speedup
+        else None
+    )
+
+    expo = registry.exposition()
+    wanted = (
+        "serve_stream_sessions_total",
+        "serve_stream_frames_total",
+        "serve_stream_cache_hits_total",
+        "serve_stream_cache_misses_total",
+        "serve_stream_full_rerun_total",
+        "serve_stream_frame_seconds",
+        "serve_stream_cache_hit_ratio",
+        "serve_stream_effective_speedup_ratio",
+    )
+    metrics_ok = all(name in expo for name in wanted)
+    smgr.close("bench")
+
+    # ---- gRPC smoke: the full StreamPredict front door under
+    # load_gen --profile video (mixed still + video traffic) ----
+    grpc_smoke = None
+    batcher = MicroBatcher(engine, manager)
+    front_smgr = StreamSessionManager(engine, manager)
+    server = ServeServer(
+        ServeService(engine, batcher, manager, stream_manager=front_smgr),
+        port=0,
+    )
+    try:
+        with ServeServerThread(server) as thread:
+            summary = run_load(
+                f"127.0.0.1:{thread.port}",
+                profile="video",
+                n_requests=4,
+                concurrency=2,
+                sizes=(max(serve_config.bucket_sizes),),
+                seed=SEED,
+                streams=1,
+                frames_per_stream=6,
+                motion_fraction=VIDEO_MOTION_FRACTION,
+                video_size=2 * max(serve_config.bucket_sizes),
+                audit_every=2,
+            )
+        video = summary["video"]
+        grpc_smoke = {
+            "frames_completed": video["frames_completed"],
+            "frames_dropped": video["dropped"],
+            "stills_completed": summary["completed"],
+            "stills_dropped": summary["dropped"],
+            "hit_ratio": video["hit_ratio"],
+            "effective_speedup": video["effective_speedup"],
+            "audit": video["audit"],
+        }
+    except Exception as e:  # the smoke must not void the in-process A/B
+        grpc_smoke = {"error": repr(e)}
+    finally:
+        batcher.close()
+        manager.stop()
+
+    return {
+        "dtype": dtype,
+        "warmup_s": round(warmup_s, 3),
+        "frame": {
+            "size": size,
+            "frames": n_frames,
+            "motion_fraction": VIDEO_MOTION_FRACTION,
+            "motion_rows": band,
+            "overlap_fraction": round(1.0 - band / size, 4),
+            "tile": max(serve_config.bucket_sizes),
+            "tile_overlap": serve_config.tile_overlap,
+            "tiles_per_frame": results[0].tiles_total,
+        },
+        "stateless": {
+            "wall_s": round(stateless_wall, 3),
+            "img_per_s": round(stateless_ips, 3) if stateless_ips else None,
+        },
+        "session": {
+            "wall_s": round(session_wall, 3),
+            "img_per_s": round(session_ips, 3) if session_ips else None,
+            "wall_speedup": (
+                round(stateless_wall / session_wall, 3) if session_wall > 0 else None
+            ),
+            "tiles_total": tiles_total,
+            "tiles_computed": tiles_computed,
+            "cache_hits": cache_hits,
+            "hit_ratio": round(cache_hits / tiles_total, 4) if tiles_total else 0.0,
+            "steady_state": {
+                "frames": len(steady),
+                "tiles_total": st_total,
+                "tiles_computed": st_computed,
+            },
+        },
+        "effective_speedup": (
+            round(effective_speedup, 3) if effective_speedup else None
+        ),
+        "effective_img_per_s": effective_ips,
+        "speedup_target_met": bool(
+            effective_speedup is not None and effective_speedup >= 3.0
+        ),
+        "identity": {
+            "frames_checked": len(results),
+            "mismatches": mismatches,
+            "ok": mismatches == 0,
+        },
+        "swap": swap_info,
+        "metrics_in_exposition": metrics_ok,
+        "grpc_smoke": grpc_smoke,
+        "note": (
+            "cached-session bytes == stateless predict_tiled bytes on every "
+            "frame, across a live mid-sequence hot swap; effective_speedup "
+            "is steady-state tiles_total/tiles_computed — the "
+            "1/(changed-tile-fraction) throughput model, seeded and "
+            "timing-independent"
+        ),
+    }
+
+
 def _bench_serve_fleet(device) -> dict:
     """Serve-fleet scale-out + quantized predict (round 17,
     detail.serve_fleet).
@@ -3121,6 +3411,27 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
         else:
             _skip(
                 skips, "serve_fleet", fleet_est, "estimate exceeds remaining budget"
+            )
+
+    # ---- video serving (round 19): the frame-coherent session plane —
+    # stateless-vs-cached-session A/B over one seeded >=90%-overlap
+    # sequence, per-frame byte-identity across a live mid-sequence hot
+    # swap, serve_stream_* exposition, and the StreamPredict gRPC smoke.
+    # Tiny weights + two small bucket programs: host-scale seconds ----
+    if VIDEO:
+        video_est = 2 * COMPILE_EST_S + VIDEO_FRAMES * 0.5 + 20.0
+        if _fits(video_est):
+            t0 = time.monotonic()
+            try:
+                detail["video_serving"] = _bench_video_serving(device)
+            except Exception as e:  # never kills the artifact
+                detail["video_serving"] = {"error": repr(e)}
+            section_s["video_serving"] = time.monotonic() - t0
+            detail["budget"] = _budget_detail()
+            _set_payload(metric_headline, value, vs_baseline, detail)
+        else:
+            _skip(
+                skips, "video_serving", video_est, "estimate exceeds remaining budget"
             )
 
     # ---- layout A/B (round 6): the VERDICT r5 top ask — space-to-depth /

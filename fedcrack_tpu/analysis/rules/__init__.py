@@ -20,6 +20,7 @@ def all_rules() -> list[Rule]:
         health_plane,
         locks,
         obs_plane,
+        serve_plane,
         trace,
         transport,
     )
@@ -27,7 +28,7 @@ def all_rules() -> list[Rule]:
     out: list[Rule] = []
     for pack in (
         determinism, durability, trace, transport, compress, async_plane,
-        obs_plane, health_plane, locks, deadcode,
+        obs_plane, health_plane, locks, deadcode, serve_plane,
     ):
         out.extend(cls() for cls in pack.RULES)
     return out

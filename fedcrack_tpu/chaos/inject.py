@@ -45,6 +45,7 @@ from fedcrack_tpu.chaos.plan import (
     NETWORK_FLAP,
     SCALED_UPDATE,
     SERVE_DEVICE_LOSS,
+    SERVE_STREAM_RESET,
     SERVE_SWAP_MIDFLIGHT,
     STALE_REPLAY,
     STRAGGLER_DELAY,
@@ -347,6 +348,32 @@ class ServeChaos:
                 f"injected serving device loss (bucket {bucket}, "
                 f"batch {batch_index}, attempt {attempt})"
             )
+
+
+class StreamChaos:
+    """``chaos=`` hook for the video-stream plane
+    (:class:`fedcrack_tpu.serve.stream.StreamSession`).
+
+    Called as ``on_frame(stream_id, frame_index, session)`` at the top of
+    each frame, BEFORE the snapshot is pinned or any tile is hashed.
+    ``SERVE_STREAM_RESET`` (matched on ``round == frame_index``, 0-based)
+    calls ``session.reset()`` — the per-stream tile cache is dropped
+    mid-stream, so the target frame must be served as a full-tile re-run.
+    The drilled claim (tools/chaos_drill.run_stream_reset_drill): the reset
+    changes LATENCY, never bytes — every frame including the reset frame
+    stays byte-identical to stateless ``predict_tiled``, and no accepted
+    frame is dropped.
+    """
+
+    def __init__(self, plan: FaultPlan, manager=None):
+        self.plan = plan
+        self.manager = manager
+
+    def on_frame(self, stream_id: str, frame_index: int, session) -> None:
+        if self.plan.take(SERVE_STREAM_RESET, round=frame_index) is not None:
+            session.reset()
+            if self.manager is not None:
+                self.manager.record_reset()
 
 
 def _nan_poison(variables, metrics):

@@ -51,6 +51,15 @@ MESH_NONFINITE = "mesh_nonfinite"              # round output poisoned with NaNs
 SERVE_SWAP_MIDFLIGHT = "serve_swap_midflight"  # install a new model while a batch is in flight
 SERVE_DEVICE_LOSS = "serve_device_loss"        # batch dispatch raises (device loss)
 
+# Video-stream plane (round 19). A mid-stream session drop: the per-stream
+# tile cache (serve/stream.py) is wiped BEFORE the target frame is served,
+# so that frame must fall back to a full-tile re-run. `round` is the
+# 0-based frame index within the stream. Consumed by
+# serve.stream.StreamChaos.on_frame; drilled by
+# tools/chaos_drill.run_stream_reset_drill, which pins zero wrong bytes
+# and zero dropped frames across the reset.
+SERVE_STREAM_RESET = "serve_stream_reset"
+
 # Serve-fleet plane (round 17). Scenario-harness kind like the edge crash:
 # a "crashed" replica runs no hook, so tools/chaos_drill.run_replica_crash_drill
 # and tests/test_fleet.py consume this from the plan, call
@@ -102,8 +111,15 @@ SERVE_KINDS = frozenset({SERVE_SWAP_MIDFLIGHT, SERVE_DEVICE_LOSS})
 TREE_KINDS = frozenset({EDGE_AGGREGATOR_CRASH})
 STORM_KINDS = frozenset({STRAGGLER_STORM})
 FLEET_KINDS = frozenset({SERVE_REPLICA_CRASH})
+STREAM_KINDS = frozenset({SERVE_STREAM_RESET})
 ALL_KINDS = (
-    CLIENT_KINDS | MESH_KINDS | SERVE_KINDS | TREE_KINDS | STORM_KINDS | FLEET_KINDS
+    CLIENT_KINDS
+    | MESH_KINDS
+    | SERVE_KINDS
+    | TREE_KINDS
+    | STORM_KINDS
+    | FLEET_KINDS
+    | STREAM_KINDS
 )
 
 
@@ -198,8 +214,9 @@ class FaultPlan:
         faults = []
         for _ in range(n_faults):
             kind = rng.choice(kind_pool)
-            if kind in MESH_KINDS or kind in SERVE_KINDS:
-                # Both planes use a 0-based index (driver round / batch).
+            if kind in MESH_KINDS or kind in SERVE_KINDS or kind in STREAM_KINDS:
+                # These planes use a 0-based index (driver round / batch /
+                # frame).
                 faults.append(Fault(kind=kind, round=rng.randrange(n_rounds)))
             else:
                 faults.append(

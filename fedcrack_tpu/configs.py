@@ -166,6 +166,19 @@ class ServeConfig:
     # time only — a request already admitted is never dropped.
     slo_p95_ms: float = 0.0
     queue_bound: int = 0
+    # ---- Frame-coherent video serving (round 19, serve/stream.py) ----
+    # Per-stream tile cache bound (entries = tiles). A video session keys
+    # cached per-tile probabilities on (model_version, tile content hash),
+    # so a new frame only re-runs tiles whose bytes changed; 0 disables
+    # caching entirely (every frame is a full re-run — the escape hatch).
+    stream_cache_tiles: int = 4096
+    # Open video sessions the serve process will hold at once; opening one
+    # past the bound is REJECTED loudly (the assembly-cap idiom).
+    stream_max_sessions: int = 64
+    # Crack-track continuity (serve/stream.py CrackTracker): a contour in
+    # frame t+1 continues the track whose last centroid lies within this
+    # fraction of the frame diagonal; beyond it a new stable id is born.
+    stream_track_match_frac: float = 0.05
 
     def __post_init__(self) -> None:
         if not self.bucket_sizes:
@@ -224,6 +237,19 @@ class ServeConfig:
             raise ValueError(f"slo_p95_ms must be >= 0, got {self.slo_p95_ms}")
         if self.queue_bound < 0:
             raise ValueError(f"queue_bound must be >= 0, got {self.queue_bound}")
+        if self.stream_cache_tiles < 0:
+            raise ValueError(
+                f"stream_cache_tiles must be >= 0, got {self.stream_cache_tiles}"
+            )
+        if self.stream_max_sessions < 1:
+            raise ValueError(
+                f"stream_max_sessions must be >= 1, got {self.stream_max_sessions}"
+            )
+        if not 0.0 < self.stream_track_match_frac <= 1.0:
+            raise ValueError(
+                f"stream_track_match_frac must be in (0, 1], got "
+                f"{self.stream_track_match_frac}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

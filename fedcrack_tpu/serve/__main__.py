@@ -63,6 +63,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="shed when queued requests across replicas reach this; 0 off",
     )
     p.add_argument(
+        "--stream-cache-tiles",
+        type=int,
+        help="per-stream tile cache bound for video sessions (entries = "
+        "tiles, keyed on (model_version, tile hash)); 0 disables caching — "
+        "every frame is a full re-run",
+    )
+    p.add_argument(
+        "--stream-max-sessions",
+        type=int,
+        help="open video sessions the serve process will hold at once",
+    )
+    p.add_argument(
         "--compile-cache-dir",
         help="persistent XLA compilation cache directory (warm replica "
         "boots; jax_compilation_cache_dir)",
@@ -123,6 +135,10 @@ def resolve_config(args):
         overrides["slo_p95_ms"] = args.slo_p95_ms
     if args.queue_bound is not None:
         overrides["queue_bound"] = args.queue_bound
+    if args.stream_cache_tiles is not None:
+        overrides["stream_cache_tiles"] = args.stream_cache_tiles
+    if args.stream_max_sessions is not None:
+        overrides["stream_max_sessions"] = args.stream_max_sessions
     if overrides:
         serve = dataclasses.replace(serve, **overrides)
     return fed.model, serve
@@ -228,8 +244,15 @@ async def _serve(args) -> int:
         from fedcrack_tpu.obs import spans as tracing
 
         tracing.install(args.spans_path)
+    # Frame-coherent video serving (round 19): per-stream tile-cached
+    # sessions behind the same front door; the weights source is the same
+    # manager the still path pins snapshots from, so a hot swap invalidates
+    # stream caches through the version in the key.
+    from fedcrack_tpu.serve.stream import StreamSessionManager
+
+    stream_manager = StreamSessionManager(engine, manager)
     server = ServeServer(
-        ServeService(engine, batcher_like, manager),
+        ServeService(engine, batcher_like, manager, stream_manager=stream_manager),
         host=serve_config.host,
         port=serve_config.port,
         max_message_mb=serve_config.max_message_mb,

@@ -38,6 +38,8 @@ log = logging.getLogger("fedcrack.serve")
 SERVE_SERVICE_NAME = "fedcrack.ServePlane"
 PREDICT_METHOD = "Predict"
 PREDICT_PATH = f"/{SERVE_SERVICE_NAME}/{PREDICT_METHOD}"
+STREAM_METHOD = "StreamPredict"
+STREAM_PATH = f"/{SERVE_SERVICE_NAME}/{STREAM_METHOD}"
 
 OK = "OK"
 REJECTED = "REJECTED"
@@ -71,10 +73,16 @@ def _reject(request_id: int, reason: str) -> pb.PredictResponse:
 class ServeService:
     """The Predict handler over one engine + batcher + weights source."""
 
-    def __init__(self, engine: Any, batcher: Any, weights: Any):
+    def __init__(
+        self, engine: Any, batcher: Any, weights: Any, stream_manager: Any = None
+    ):
         self.engine = engine
         self.batcher = batcher
         self.weights = weights
+        # Frame-coherent video serving (round 19): a StreamSessionManager
+        # turns StreamPredict RPCs into per-stream tile-cached sessions.
+        # None leaves the RPC registered but loudly rejecting.
+        self.stream_manager = stream_manager
         self._lock = make_lock("serve.service.stats")
         self.tiled_served = 0
         self.rejected = 0
@@ -228,6 +236,188 @@ class ServeService:
                     self.rejected += 1
                 yield _reject(msg.request_id, repr(e))
 
+    # ---- the video-stream handler (round 19) ----
+
+    async def stream_session(
+        self, request_iterator: AsyncIterator[pb.StreamRequest], context
+    ) -> AsyncIterator[pb.StreamResponse]:
+        """One open/frames/close video session protocol over a bidi stream.
+
+        Every Open, every completed frame, and every Close gets exactly one
+        response (clients count 1:1); frame chunks reuse the LogChunk
+        offset/last + optional CRC32C idiom. Frames within a stream are
+        served in arrival order — the ordering the tile cache and the crack
+        tracker are defined over. Sessions opened on this RPC are closed
+        when the RPC ends, so a dropped connection cannot leak session
+        slots toward the ``stream_max_sessions`` bound."""
+        from fedcrack_tpu.serve.stream import tracks_to_json
+
+        opened: dict[str, Any] = {}      # stream_id -> StreamSession
+        frames: dict[str, dict] = {}     # stream_id -> in-flight chunk state
+        try:
+            async for msg in request_iterator:
+                sid = msg.stream_id
+                kind = msg.WhichOneof("msg")
+                if self.stream_manager is None:
+                    with self._lock:
+                        self.rejected += 1
+                    yield pb.StreamResponse(
+                        status=REJECTED, title="video serving not enabled"
+                    )
+                    continue
+                if kind == "open":
+                    o = msg.open
+                    if o.channels not in (0, 3):
+                        bad = f"channels must be 3 (RGB), got {o.channels}"
+                    elif sid in opened:
+                        bad = f"stream {sid!r} is already open on this call"
+                    else:
+                        bad = None
+                    if bad is None:
+                        try:
+                            opened[sid] = self.stream_manager.open(
+                                sid,
+                                height=o.height,
+                                width=o.width,
+                                track=o.track,
+                                smooth_alpha=o.smooth_alpha,
+                                threshold=o.threshold,
+                            )
+                        except ValueError as e:
+                            bad = str(e)
+                    if bad is not None:
+                        with self._lock:
+                            self.rejected += 1
+                        yield pb.StreamResponse(status=REJECTED, title=bad)
+                        continue
+                    yield pb.StreamResponse(
+                        status=OK,
+                        title="OPENED",
+                        height=o.height,
+                        width=o.width,
+                    )
+                elif kind == "frame":
+                    session = opened.get(sid)
+                    if session is None:
+                        with self._lock:
+                            self.rejected += 1
+                        yield pb.StreamResponse(
+                            frame_id=msg.frame.frame_id,
+                            status=REJECTED,
+                            title=f"stream {sid!r} is not open",
+                        )
+                        continue
+                    f = msg.frame
+                    if f.HasField("crc32c"):
+                        from fedcrack_tpu.native import crc32c
+
+                        got = crc32c(f.image)
+                        if got != f.crc32c:
+                            frames.pop(sid, None)
+                            with self._lock:
+                                self.rejected += 1
+                            yield pb.StreamResponse(
+                                frame_id=f.frame_id,
+                                status=REJECTED,
+                                title=(
+                                    f"frame chunk checksum mismatch at offset "
+                                    f"{f.offset}: computed {got:#010x}, "
+                                    f"declared {f.crc32c:#010x}"
+                                ),
+                            )
+                            continue
+                    st = frames.get(sid)
+                    if st is None or st["frame_id"] != f.frame_id:
+                        st = {"frame_id": f.frame_id, "chunks": bytearray()}
+                        frames[sid] = st
+                    if f.offset != len(st["chunks"]):
+                        frames.pop(sid, None)
+                        with self._lock:
+                            self.rejected += 1
+                        yield pb.StreamResponse(
+                            frame_id=f.frame_id,
+                            status=REJECTED,
+                            title=(
+                                f"chunk offset {f.offset} != received "
+                                f"{len(st['chunks'])}"
+                            ),
+                        )
+                        continue
+                    st["chunks"].extend(f.image)
+                    if not f.last:
+                        continue
+                    frames.pop(sid, None)
+                    want = session.height * session.width * 3
+                    if len(st["chunks"]) != want:
+                        with self._lock:
+                            self.rejected += 1
+                        yield pb.StreamResponse(
+                            frame_id=f.frame_id,
+                            status=REJECTED,
+                            title=(
+                                f"frame bytes {len(st['chunks'])} != "
+                                f"{session.height}x{session.width}x3"
+                            ),
+                        )
+                        continue
+                    image = np.frombuffer(bytes(st["chunks"]), np.uint8).reshape(
+                        session.height, session.width, 3
+                    )
+                    try:
+                        result = await asyncio.to_thread(
+                            session.process_frame, image
+                        )
+                    except Exception as e:  # errors THIS frame only
+                        log.exception(
+                            "stream frame failed (%s, frame %d)", sid, f.frame_id
+                        )
+                        with self._lock:
+                            self.rejected += 1
+                        yield pb.StreamResponse(
+                            frame_id=f.frame_id, status=REJECTED, title=repr(e)
+                        )
+                        continue
+                    self.stream_manager.record(result)
+                    yield pb.StreamResponse(
+                        frame_id=f.frame_id,
+                        status=OK,
+                        mask=result.mask_bytes(session.threshold),
+                        model_version=result.model_version,
+                        latency_ms=result.latency_ms,
+                        height=session.height,
+                        width=session.width,
+                        tiles_total=result.tiles_total,
+                        tiles_computed=result.tiles_computed,
+                        cache_hits=result.cache_hits,
+                        full_rerun=result.full_rerun,
+                        tracks_json=(
+                            tracks_to_json(result.tracks)
+                            if session.tracker is not None
+                            else ""
+                        ),
+                    )
+                elif kind == "close":
+                    if opened.pop(sid, None) is None:
+                        with self._lock:
+                            self.rejected += 1
+                        yield pb.StreamResponse(
+                            status=REJECTED, title=f"stream {sid!r} is not open"
+                        )
+                        continue
+                    self.stream_manager.close(sid)
+                    frames.pop(sid, None)
+                    yield pb.StreamResponse(status=OK, title="CLOSED")
+                else:
+                    with self._lock:
+                        self.rejected += 1
+                    yield pb.StreamResponse(
+                        status=REJECTED, title="empty StreamRequest"
+                    )
+        finally:
+            if self.stream_manager is not None:
+                for sid in opened:
+                    self.stream_manager.close(sid)
+
 
 class ServeServer:
     """Binds a :class:`ServeService` on an asyncio gRPC server."""
@@ -253,10 +443,16 @@ class ServeServer:
             request_deserializer=pb.PredictRequest.FromString,
             response_serializer=pb.PredictResponse.SerializeToString,
         )
+        stream_handler = grpc.stream_stream_rpc_method_handler(
+            self.service.stream_session,
+            request_deserializer=pb.StreamRequest.FromString,
+            response_serializer=pb.StreamResponse.SerializeToString,
+        )
         server.add_generic_rpc_handlers(
             (
                 grpc.method_handlers_generic_handler(
-                    SERVE_SERVICE_NAME, {PREDICT_METHOD: handler}
+                    SERVE_SERVICE_NAME,
+                    {PREDICT_METHOD: handler, STREAM_METHOD: stream_handler},
                 ),
             )
         )

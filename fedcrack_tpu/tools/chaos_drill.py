@@ -837,6 +837,116 @@ def run_replica_crash_drill() -> dict:
         fleet.close()
 
 
+def run_stream_reset_drill() -> dict:
+    """SERVE_STREAM_RESET drill (round 19): a mid-stream session drop on
+    the video serving plane.
+
+    A video session (tiny engine, multi-tile frames) serves a seeded
+    correlated frame sequence while a chaos plan schedules a
+    ``SERVE_STREAM_RESET`` at a mid-sequence frame — ``StreamChaos``
+    consumes it and wipes the per-stream tile cache BEFORE that frame is
+    served. The pinned claims:
+
+    - the reset stream falls back to a full-tile re-run on the reset frame
+      (``tiles_computed == tiles_total``, zero cache hits);
+    - ZERO wrong bytes: every frame, including the reset frame and the
+      cache-warm frames around it, is byte-identical to stateless
+      ``engine.predict_tiled`` under the same weights snapshot;
+    - zero dropped accepted requests: every submitted frame answers.
+
+    The fault is scheduled and consumed through the plan, so the artifact
+    proves the reset actually fired instead of silently matching nothing.
+    """
+    import jax
+
+    from fedcrack_tpu.chaos.inject import StreamChaos
+    from fedcrack_tpu.chaos.plan import SERVE_STREAM_RESET, Fault, FaultPlan
+    from fedcrack_tpu.configs import ModelConfig, ServeConfig
+    from fedcrack_tpu.models.resunet import init_variables
+    from fedcrack_tpu.obs.registry import MetricsRegistry
+    from fedcrack_tpu.serve.engine import InferenceEngine
+    from fedcrack_tpu.serve.stream import StreamSessionManager
+    from fedcrack_tpu.tools.load_gen import make_frame_sequence
+
+    model_config = ModelConfig(
+        img_size=32, stem_features=4, encoder_features=(8,), decoder_features=(8, 4)
+    )
+    serve_config = ServeConfig(
+        bucket_sizes=(16, 32), max_batch=4, max_delay_ms=10.0, tile_overlap=4
+    )
+    engine = InferenceEngine(model_config, serve_config)
+    variables = engine.prepare(init_variables(jax.random.key(0), model_config))
+
+    class _Static:
+        def snapshot(self):
+            return 0, variables
+
+    n_frames, reset_at = 8, 4
+    plan = FaultPlan([Fault(kind=SERVE_STREAM_RESET, round=reset_at)])
+    manager = StreamSessionManager(
+        engine,
+        _Static(),
+        chaos=StreamChaos(plan, manager=None),
+        registry=MetricsRegistry(),
+    )
+    manager.chaos.manager = manager
+    frames = make_frame_sequence(n_frames, 64, 0.1, seed=7)
+    session = manager.open("drill", height=64, width=64)
+    t_start = time.perf_counter()
+    wrong_bytes = 0
+    answered = 0
+    reset_frame = None
+    per_frame = []
+    for fi, frame in enumerate(frames):
+        result = session.process_frame(frame)
+        manager.record(result)
+        answered += 1
+        ref = engine.predict_tiled(variables, frame)
+        identical = result.probs.tobytes() == ref.tobytes()
+        if not identical:
+            wrong_bytes += 1
+        if fi == reset_at:
+            reset_frame = {
+                "frame": fi,
+                "full_rerun": result.full_rerun,
+                "tiles_computed": result.tiles_computed,
+                "tiles_total": result.tiles_total,
+                "cache_hits": result.cache_hits,
+            }
+        per_frame.append(
+            {
+                "frame": fi,
+                "hits": result.cache_hits,
+                "computed": result.tiles_computed,
+                "identical": identical,
+            }
+        )
+    manager.close("drill")
+    fired = [f.kind for f in plan.triggered]
+    stats = manager.stats()
+    return {
+        "frames": n_frames,
+        "reset_at": reset_at,
+        "fault_fired": SERVE_STREAM_RESET in fired,
+        "resets_recorded": session.totals["resets"],
+        "answered": answered,
+        "dropped": n_frames - answered,
+        "zero_dropped": answered == n_frames,
+        "wrong_bytes": wrong_bytes,
+        "zero_wrong_bytes": wrong_bytes == 0,
+        "reset_frame": reset_frame,
+        "reset_was_full_rerun": bool(
+            reset_frame
+            and reset_frame["full_rerun"]
+            and reset_frame["tiles_computed"] == reset_frame["tiles_total"]
+        ),
+        "per_frame": per_frame,
+        "hit_ratio": stats["hit_ratio"],
+        "effective_speedup": stats["effective_speedup"],
+        "drill_s": round(time.perf_counter() - t_start, 3),
+    }
+
+
 def run_scaled_update_drill() -> dict:
     """SCALED_UPDATE drill (round 18, Blanchard et al.'s threat model): an
     adversarially AMPLIFIED update — the client's real trained weights
@@ -1043,6 +1153,7 @@ def main(argv=None) -> int:
             "buffered_kill": run_buffered_kill_drill(),
             "replica_crash": run_replica_crash_drill(),
             "scaled_update": run_scaled_update_drill(),
+            "stream_reset": run_stream_reset_drill(),
         }
     except BaseException:
         flight.dump("chaos drill failed")

@@ -53,7 +53,7 @@ import numpy as np
 from fedcrack_tpu.obs.metrics import StreamingPercentiles
 from fedcrack_tpu.transport import transport_pb2 as pb
 from fedcrack_tpu.transport.service import channel_options
-from fedcrack_tpu.serve.service import OK, PREDICT_PATH, SHED
+from fedcrack_tpu.serve.service import OK, PREDICT_PATH, SHED, STREAM_PATH
 
 _STOP = object()
 
@@ -70,7 +70,7 @@ DIURNAL_PHASES = (
     ("diurnal_peak", 1.8),
     ("diurnal_evening", 0.8),
 )
-PROFILES = ("const", "ramp", "diurnal")
+PROFILES = ("const", "ramp", "diurnal", "video")
 
 
 def arrival_schedule(
@@ -89,6 +89,11 @@ def arrival_schedule(
 
     if profile not in PROFILES:
         raise ValueError(f"profile must be one of {PROFILES}, got {profile!r}")
+    if profile == "video":
+        raise ValueError(
+            "video is a session profile (StreamPredict), not an arrival "
+            "schedule; run_load dispatches it before scheduling"
+        )
     if n < 1:
         raise ValueError(f"n must be >= 1, got {n}")
     if rate_rps <= 0:
@@ -143,6 +148,37 @@ def make_images(
         size = sizes[i % len(sizes)]
         out.append(per_size[size].pop())
     return out
+
+
+def make_frame_sequence(
+    n_frames: int, size: int, motion_fraction: float, seed: int = 0
+) -> list[np.ndarray]:
+    """A seeded correlated video sequence: frame 0 is a synthetic crack
+    image, each later frame copies its predecessor and rewrites a contiguous
+    row band of ``motion_fraction * size`` rows at a moving offset — the
+    motion band a vehicle-mounted camera produces. ``motion_fraction`` 0 is
+    a static camera (all frames byte-identical), 1.0 rewrites the whole
+    frame every time (zero exploitable coherence). Same (n_frames, size,
+    motion_fraction, seed) -> same bytes."""
+    if n_frames < 1:
+        raise ValueError(f"n_frames must be >= 1, got {n_frames}")
+    if not 0.0 <= motion_fraction <= 1.0:
+        raise ValueError(
+            f"motion_fraction must be in [0, 1], got {motion_fraction}"
+        )
+    rng = np.random.default_rng(seed)
+    base = make_images(1, (size,), seed)[0]
+    frames = [base]
+    band = int(round(motion_fraction * size))
+    for t in range(1, n_frames):
+        f = frames[-1].copy()
+        if band > 0:
+            r0 = (t * band) % max(1, size - band + 1)
+            f[r0 : r0 + band] = rng.integers(
+                0, 256, (band, size, 3), dtype=np.uint8
+            )
+        frames.append(f)
+    return frames
 
 
 def _request_chunks(
@@ -275,6 +311,217 @@ def _stream_call(channel):
         request_serializer=pb.PredictRequest.SerializeToString,
         response_deserializer=pb.PredictResponse.FromString,
     )
+
+
+def _video_call(channel):
+    return channel.stream_stream(
+        STREAM_PATH,
+        request_serializer=pb.StreamRequest.SerializeToString,
+        response_deserializer=pb.StreamResponse.FromString,
+    )
+
+
+def _frame_chunks(stream_id, frame_id, image, *, chunk_bytes, crc):
+    """LogChunk-style framing of one video frame over StreamRequest."""
+    blob = image.tobytes()
+    n = max(1, chunk_bytes)
+    for off in range(0, len(blob), n):
+        piece = blob[off : off + n]
+        f = pb.StreamFrame(
+            frame_id=frame_id,
+            image=piece,
+            offset=off,
+            last=off + n >= len(blob),
+        )
+        if crc:
+            from fedcrack_tpu.native import crc32c
+
+            f.crc32c = crc32c(piece)
+        yield pb.StreamRequest(stream_id=stream_id, frame=f)
+
+
+def _predict_once(predict_stub, rid: int, image: np.ndarray, opts: dict):
+    """One stateless Predict of ``image`` on a fresh RPC (the identity-audit
+    reference call); returns the PredictResponse or None."""
+    msgs = list(
+        _request_chunks(
+            rid,
+            image,
+            threshold=opts["threshold"],
+            deadline_ms=0.0,
+            chunk_bytes=opts["chunk_bytes"],
+            crc=opts["crc"],
+        )
+    )
+    try:
+        return next(predict_stub(iter(msgs)))
+    except StopIteration:
+        return None
+
+
+class _VideoStats:
+    """Thread-safe aggregation across video stream workers."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.frames_sent = 0
+        self.frames_completed = 0
+        self.frames_rejected = 0
+        self.tiles_total = 0
+        self.tiles_computed = 0
+        self.cache_hits = 0
+        self.full_reruns = 0
+        self.open_failed = 0
+        self.versions: dict[str, int] = {}
+        self.latency = StreamingPercentiles(8192)
+        # Wire-level byte-identity audit: sampled frames re-served through
+        # the STATELESS Predict RPC and compared mask-for-mask. Masks are
+        # only comparable when both answers came from the SAME model
+        # version (a hot swap between the two calls legitimately changes
+        # the output) — those samples count as version_skipped, not failed.
+        self.audit = {
+            "checked": 0,
+            "matched": 0,
+            "mismatched": 0,
+            "version_skipped": 0,
+        }
+
+    def summary(self, streams: int, frames_per_stream: int, mf: float) -> dict:
+        with self.lock:
+            t, c = self.tiles_total, self.tiles_computed
+            audit = dict(self.audit)
+            audit["ok"] = audit["mismatched"] == 0
+            return {
+                "streams": streams,
+                "frames_per_stream": frames_per_stream,
+                "motion_fraction": mf,
+                "frames_sent": self.frames_sent,
+                "frames_completed": self.frames_completed,
+                "frames_rejected": self.frames_rejected,
+                "dropped": (
+                    self.frames_sent
+                    - self.frames_completed
+                    - self.frames_rejected
+                ),
+                "open_failed": self.open_failed,
+                "tiles_total": t,
+                "tiles_computed": c,
+                "cache_hits": self.cache_hits,
+                "full_reruns": self.full_reruns,
+                "hit_ratio": round(self.cache_hits / t, 4) if t else 0.0,
+                "effective_speedup": round(t / c, 3) if c else 1.0,
+                "frame_latency_ms": self.latency.summary(),
+                "versions_observed": dict(self.versions),
+                "audit": audit,
+            }
+
+
+def _video_stream(
+    channel,
+    stream_id: str,
+    frames: list[np.ndarray],
+    stats: _VideoStats,
+    opts: dict,
+    audit_every: int,
+    on_complete,
+) -> None:
+    """Drive one StreamPredict session: open, feed every frame in order,
+    close. Every ``audit_every``-th completed frame is re-served through the
+    stateless Predict RPC on the same channel and byte-compared."""
+    size = frames[0].shape[0]
+    send_q: Queue = Queue()
+
+    def request_iter():
+        while True:
+            item = send_q.get()
+            if item is _STOP:
+                return
+            yield from item
+
+    responses = _video_call(channel)(request_iter())
+    predict_stub = _stream_call(channel)
+    try:
+        send_q.put(
+            [
+                pb.StreamRequest(
+                    stream_id=stream_id,
+                    open=pb.StreamOpen(
+                        height=size,
+                        width=size,
+                        channels=3,
+                        threshold=opts["threshold"],
+                        track=opts.get("track", False),
+                    ),
+                )
+            ]
+        )
+        try:
+            ack = next(responses)
+        except StopIteration:
+            with stats.lock:
+                stats.open_failed += 1
+            return
+        if ack.status != OK:
+            with stats.lock:
+                stats.open_failed += 1
+            return
+        for fi, frame in enumerate(frames):
+            with stats.lock:
+                stats.frames_sent += 1
+            t0 = time.perf_counter()
+            send_q.put(
+                list(
+                    _frame_chunks(
+                        stream_id,
+                        fi + 1,
+                        frame,
+                        chunk_bytes=opts["chunk_bytes"],
+                        crc=opts["crc"],
+                    )
+                )
+            )
+            try:
+                resp = next(responses)
+            except StopIteration:
+                return  # server ended the stream; unsent frames are drops
+            lat_ms = (time.perf_counter() - t0) * 1e3
+            with stats.lock:
+                if resp.status != OK:
+                    stats.frames_rejected += 1
+                    continue
+                stats.frames_completed += 1
+                stats.tiles_total += resp.tiles_total
+                stats.tiles_computed += resp.tiles_computed
+                stats.cache_hits += resp.cache_hits
+                if resp.full_rerun:
+                    stats.full_reruns += 1
+                v = str(resp.model_version)
+                stats.versions[v] = stats.versions.get(v, 0) + 1
+                stats.latency.add(lat_ms)
+            if audit_every > 0 and fi % audit_every == 0:
+                ref = _predict_once(predict_stub, fi + 1, frame, opts)
+                with stats.lock:
+                    if ref is None or ref.status != OK:
+                        pass  # audit reference failed; not a stream defect
+                    elif ref.model_version != resp.model_version:
+                        stats.audit["version_skipped"] += 1
+                    else:
+                        stats.audit["checked"] += 1
+                        if ref.mask == resp.mask:
+                            stats.audit["matched"] += 1
+                        else:
+                            stats.audit["mismatched"] += 1
+            if on_complete is not None:
+                on_complete()
+        send_q.put(
+            [pb.StreamRequest(stream_id=stream_id, close=pb.StreamClose())]
+        )
+        try:
+            next(responses)  # close ack
+        except StopIteration:
+            pass
+    finally:
+        send_q.put(_STOP)
 
 
 def _closed_worker(
@@ -447,14 +694,52 @@ def run_load(
     keep_masks: bool = False,
     max_message_mb: int = 64,
     on_complete=None,
+    streams: int = 2,
+    frames_per_stream: int = 16,
+    motion_fraction: float = 0.1,
+    video_size: int = 320,
+    audit_every: int = 4,
+    track: bool = False,
 ) -> dict:
     """Drive the endpoint; returns the JSON-safe summary (see module doc).
     ``on_complete()`` fires after every completed request — harnesses hook
-    swap triggers on it."""
+    swap triggers on it.
+
+    ``--profile video`` (round 19) is a SESSION profile, not an arrival
+    schedule: ``streams`` StreamPredict sessions each feed
+    ``frames_per_stream`` seeded correlated frames (``motion_fraction``
+    controls the moving row band) while ``n_requests`` ordinary still
+    requests run closed-loop through the same front door — mixed traffic
+    over one router. Every ``audit_every``-th frame is also served through
+    the stateless Predict RPC and byte-compared (the wire-level identity
+    audit); the ``video`` summary block carries cache hit ratio, effective
+    speedup (tiles_total/tiles_computed) and the audit verdict."""
     import grpc
 
     if mode not in ("closed", "open"):
         raise ValueError(f"mode must be 'closed' or 'open', got {mode!r}")
+    if profile == "video":
+        return _run_video_load(
+            target,
+            n_requests=n_requests,
+            concurrency=concurrency,
+            sizes=sizes,
+            seed=seed,
+            threshold=threshold,
+            deadline_ms=deadline_ms,
+            chunk_bytes=chunk_bytes,
+            crc=crc,
+            timeout_s=timeout_s,
+            keep_masks=keep_masks,
+            max_message_mb=max_message_mb,
+            on_complete=on_complete,
+            streams=streams,
+            frames_per_stream=frames_per_stream,
+            motion_fraction=motion_fraction,
+            video_size=video_size,
+            audit_every=audit_every,
+            track=track,
+        )
     if profile != "const" and mode != "open":
         raise ValueError(
             f"profile {profile!r} needs open-loop injection (--mode open); "
@@ -539,6 +824,144 @@ def run_load(
     }
 
 
+def _run_video_load(
+    target: str,
+    *,
+    n_requests: int,
+    concurrency: int,
+    sizes: Sequence[int],
+    seed: int,
+    threshold: float,
+    deadline_ms: float,
+    chunk_bytes: int,
+    crc: bool,
+    timeout_s: float,
+    keep_masks: bool,
+    max_message_mb: int,
+    on_complete,
+    streams: int,
+    frames_per_stream: int,
+    motion_fraction: float,
+    video_size: int,
+    audit_every: int,
+    track: bool,
+) -> dict:
+    """The ``--profile video`` driver: ``streams`` video sessions plus
+    ``n_requests`` closed-loop stills through the same server/channel."""
+    import grpc
+
+    if streams < 1:
+        raise ValueError(f"streams must be >= 1, got {streams}")
+    if frames_per_stream < 1:
+        raise ValueError(
+            f"frames_per_stream must be >= 1, got {frames_per_stream}"
+        )
+    if video_size < 1:
+        raise ValueError(f"video_size must be >= 1, got {video_size}")
+    sequences = [
+        make_frame_sequence(
+            frames_per_stream, video_size, motion_fraction, seed + si
+        )
+        for si in range(streams)
+    ]
+    still_images = make_images(n_requests, sizes, seed) if n_requests else []
+    collector = _Collector()
+    stats = _VideoStats()
+    opts = {
+        "threshold": threshold,
+        "deadline_ms": deadline_ms,
+        "chunk_bytes": chunk_bytes,
+        "crc": crc,
+        "timeout_s": timeout_s,
+        "keep_masks": keep_masks,
+        "track": track,
+    }
+    channel = grpc.insecure_channel(target, options=channel_options(max_message_mb))
+    t_start = time.perf_counter()
+    try:
+        grpc.channel_ready_future(channel).result(timeout=30)
+        video_threads = [
+            threading.Thread(
+                target=_video_stream,
+                args=(
+                    channel,
+                    f"video-{si}",
+                    sequences[si],
+                    stats,
+                    opts,
+                    audit_every,
+                    on_complete,
+                ),
+                daemon=True,
+            )
+            for si in range(streams)
+        ]
+        still_threads = []
+        if still_images:
+            stub = _stream_call(channel)
+            jobs: Queue = Queue()
+            for rid, image in enumerate(still_images):
+                jobs.put((rid, image))
+            still_threads = [
+                threading.Thread(
+                    target=_closed_worker,
+                    args=(stub, jobs, collector, opts, on_complete),
+                    daemon=True,
+                )
+                for _ in range(max(1, concurrency))
+            ]
+        for t in video_threads + still_threads:
+            t.start()
+        deadline = time.monotonic() + timeout_s
+        for t in video_threads + still_threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+    finally:
+        channel.close()
+    wall_s = time.perf_counter() - t_start
+
+    with collector.lock:
+        completed = collector.completed
+        rejected = collector.rejected
+        shed = collector.shed
+        per_size = dict(collector.per_size)
+        versions = dict(collector.versions)
+    video = stats.summary(streams, frames_per_stream, motion_fraction)
+    frames_done = video["frames_completed"]
+    # Effective img/s: completed frames scaled by the work a stateless
+    # server would have done for them (tiles_total / tiles_computed) —
+    # the ~1/(changed-tile-fraction) model, measured on the wire.
+    video["frames_per_s"] = (
+        round(frames_done / wall_s, 3) if wall_s > 0 else None
+    )
+    video["effective_frames_per_s"] = (
+        round(frames_done * video["effective_speedup"] / wall_s, 3)
+        if wall_s > 0
+        else None
+    )
+    return {
+        "mode": "video",
+        "target": target,
+        "n_requests": n_requests,
+        "completed": completed,
+        "rejected": rejected,
+        "shed": shed,
+        "dropped": n_requests - completed - rejected - shed,
+        "wall_s": round(wall_s, 3),
+        "throughput_rps": round(completed / wall_s, 3) if wall_s > 0 else None,
+        "concurrency": concurrency,
+        "rate_rps": None,
+        "profile": "video",
+        "per_phase": None,
+        "sizes": list(sizes),
+        "per_size": per_size,
+        "versions_observed": versions,
+        "latency_ms": collector.latency.summary(),
+        "server_latency_ms": collector.server_latency.summary(),
+        "masks": collector.masks if keep_masks else None,
+        "video": video,
+    }
+
+
 def write_masks(masks, out_dir: str) -> int:
     """Dump (request_id, h, w, bytes) masks as PNGs for tools/quantify.py
     --pred-dir; returns how many were written."""
@@ -571,7 +994,36 @@ def main(argv=None) -> int:
         choices=list(PROFILES),
         default="const",
         help="open-loop arrival profile: const (fixed rate), ramp "
-        "(0.25x->2x rate steps), diurnal (compressed-day replay); seeded",
+        "(0.25x->2x rate steps), diurnal (compressed-day replay); seeded. "
+        "'video' is a session profile instead: StreamPredict sessions with "
+        "seeded correlated frames mixed with closed-loop stills",
+    )
+    p.add_argument(
+        "--streams", type=int, default=2,
+        help="video profile: concurrent StreamPredict sessions",
+    )
+    p.add_argument(
+        "--frames", type=int, default=16,
+        help="video profile: frames per stream",
+    )
+    p.add_argument(
+        "--motion-fraction", type=float, default=0.1,
+        help="video profile: fraction of frame rows rewritten per frame "
+        "(0 = static camera, 1 = zero frame coherence)",
+    )
+    p.add_argument(
+        "--video-size", type=int, default=320,
+        help="video profile: square frame edge in px (multi-tile frames "
+        "need this larger than the server's largest bucket)",
+    )
+    p.add_argument(
+        "--audit-every", type=int, default=4,
+        help="video profile: byte-compare every Nth frame against the "
+        "stateless Predict RPC (0 disables the identity audit)",
+    )
+    p.add_argument(
+        "--track", action="store_true",
+        help="video profile: enable server-side crack-track continuity",
     )
     p.add_argument("--sizes", default="128", help="comma-separated request sizes")
     p.add_argument("--seed", type=int, default=0)
@@ -645,13 +1097,25 @@ def main(argv=None) -> int:
         timeout_s=args.timeout_s,
         keep_masks=bool(args.out_dir),
         on_complete=on_complete if args.swap_statefile else None,
+        streams=args.streams,
+        frames_per_stream=args.frames,
+        motion_fraction=args.motion_fraction,
+        video_size=args.video_size,
+        audit_every=args.audit_every,
+        track=args.track,
     )
     masks = summary.pop("masks", None)
     if args.out_dir and masks:
         summary["masks_written"] = write_masks(masks, args.out_dir)
     summary["swap_published"] = swap_state["fired"] if args.swap_statefile else None
     print(json.dumps(summary), flush=True)
-    return 0 if summary["dropped"] == 0 else 1
+    video = summary.get("video")
+    video_ok = video is None or (
+        video["dropped"] == 0
+        and video["open_failed"] == 0
+        and video["audit"]["ok"]
+    )
+    return 0 if summary["dropped"] == 0 and video_ok else 1
 
 
 if __name__ == "__main__":

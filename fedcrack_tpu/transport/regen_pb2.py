@@ -30,12 +30,14 @@ PB2_PATH = os.path.join(HERE, "transport_pb2.py")
 F = dp.FieldDescriptorProto
 
 
-def _field(name, number, ftype, *, optional=False, oneof_index=None):
+def _field(name, number, ftype, *, optional=False, oneof_index=None, type_name=None):
     f = F(name=name, number=number, type=ftype, label=F.LABEL_OPTIONAL)
     if optional:
         f.proto3_optional = True
     if oneof_index is not None:
         f.oneof_index = oneof_index
+    if type_name is not None:
+        f.type_name = type_name
     return f
 
 
@@ -80,12 +82,98 @@ def _predict_response() -> dp.DescriptorProto:
     return msg
 
 
+def _stream_open() -> dp.DescriptorProto:
+    msg = dp.DescriptorProto(name="StreamOpen")
+    msg.field.extend(
+        [
+            _field("height", 1, F.TYPE_INT32),
+            _field("width", 2, F.TYPE_INT32),
+            _field("channels", 3, F.TYPE_INT32),
+            _field("threshold", 4, F.TYPE_FLOAT),
+            _field("track", 5, F.TYPE_BOOL),
+            _field("smooth_alpha", 6, F.TYPE_FLOAT),
+        ]
+    )
+    return msg
+
+
+def _stream_frame() -> dp.DescriptorProto:
+    msg = dp.DescriptorProto(name="StreamFrame")
+    msg.field.extend(
+        [
+            _field("frame_id", 1, F.TYPE_UINT64),
+            _field("image", 2, F.TYPE_BYTES),
+            _field("offset", 3, F.TYPE_INT64),
+            _field("last", 4, F.TYPE_BOOL),
+            _field("crc32c", 5, F.TYPE_FIXED32, optional=True, oneof_index=0),
+        ]
+    )
+    msg.oneof_decl.add(name="_crc32c")
+    return msg
+
+
+def _stream_close() -> dp.DescriptorProto:
+    return dp.DescriptorProto(name="StreamClose")
+
+
+def _stream_request() -> dp.DescriptorProto:
+    msg = dp.DescriptorProto(name="StreamRequest")
+    msg.field.extend(
+        [
+            _field("stream_id", 1, F.TYPE_STRING),
+            _field(
+                "open", 2, F.TYPE_MESSAGE,
+                oneof_index=0, type_name=".fedcrack.StreamOpen",
+            ),
+            _field(
+                "frame", 3, F.TYPE_MESSAGE,
+                oneof_index=0, type_name=".fedcrack.StreamFrame",
+            ),
+            _field(
+                "close", 4, F.TYPE_MESSAGE,
+                oneof_index=0, type_name=".fedcrack.StreamClose",
+            ),
+        ]
+    )
+    msg.oneof_decl.add(name="msg")
+    return msg
+
+
+def _stream_response() -> dp.DescriptorProto:
+    msg = dp.DescriptorProto(name="StreamResponse")
+    msg.field.extend(
+        [
+            _field("frame_id", 1, F.TYPE_UINT64),
+            _field("status", 2, F.TYPE_STRING),
+            _field("mask", 3, F.TYPE_BYTES),
+            _field("model_version", 4, F.TYPE_INT32),
+            _field("latency_ms", 5, F.TYPE_FLOAT),
+            _field("height", 6, F.TYPE_INT32),
+            _field("width", 7, F.TYPE_INT32),
+            _field("title", 8, F.TYPE_STRING),
+            _field("tiles_total", 9, F.TYPE_INT32),
+            _field("tiles_computed", 10, F.TYPE_INT32),
+            _field("cache_hits", 11, F.TYPE_INT32),
+            _field("full_rerun", 12, F.TYPE_BOOL),
+            _field("tracks_json", 13, F.TYPE_STRING),
+        ]
+    )
+    return msg
+
+
 def _serve_plane() -> dp.ServiceDescriptorProto:
     svc = dp.ServiceDescriptorProto(name="ServePlane")
     svc.method.add(
         name="Predict",
         input_type=".fedcrack.PredictRequest",
         output_type=".fedcrack.PredictResponse",
+        client_streaming=True,
+        server_streaming=True,
+    )
+    svc.method.add(
+        name="StreamPredict",
+        input_type=".fedcrack.StreamRequest",
+        output_type=".fedcrack.StreamResponse",
         client_streaming=True,
         server_streaming=True,
     )
@@ -112,13 +200,32 @@ def current_serialized_pb() -> bytes:
 def build_file_descriptor() -> dp.FileDescriptorProto:
     fdp = dp.FileDescriptorProto.FromString(current_serialized_pb())
     have_msgs = {m.name for m in fdp.message_type}
-    for make in (_predict_request, _predict_response):
+    for make in (
+        _predict_request,
+        _predict_response,
+        _stream_open,
+        _stream_frame,
+        _stream_close,
+        _stream_request,
+        _stream_response,
+    ):
         msg = make()
         if msg.name not in have_msgs:
             fdp.message_type.append(msg)
     have_svcs = {s.name for s in fdp.service}
     if "ServePlane" not in have_svcs:
         fdp.service.append(_serve_plane())
+    else:
+        # The service already exists from an earlier round: append any
+        # methods defined here that it is missing (same pass-through rule
+        # as messages — existing method descriptors are untouched).
+        for svc in fdp.service:
+            if svc.name != "ServePlane":
+                continue
+            have_methods = {m.name for m in svc.method}
+            for m in _serve_plane().method:
+                if m.name not in have_methods:
+                    svc.method.append(m)
     return fdp
 
 

@@ -533,6 +533,62 @@ def test_dead002_unreachable_positive_and_negative():
     assert "DEAD002" not in rule_ids(lint(good))
 
 
+# ---- serve-plane pack ----
+
+
+def test_serve001_cache_lookup_without_version_is_error():
+    bad_subscript = (
+        "def f(self, h, w):\n"
+        "    return self._cache[(h, w)]\n"
+    )
+    bad_get = (
+        "def f(self, digest):\n"
+        "    return self._cache.get(digest)\n"
+    )
+    bad_traced = (
+        "def f(self, digest):\n"
+        "    key = (digest, 0)\n"
+        "    return tile_cache.get(key)\n"
+    )
+    for src in (bad_subscript, bad_get, bad_traced):
+        findings = lint(src, path="fedcrack_tpu/serve/fixture.py")
+        assert "SERVE001" in rule_ids(findings), src
+        hit = findings[rule_ids(findings).index("SERVE001")]
+        assert hit.severity is Severity.ERROR
+        assert "hot swap" in hit.message
+
+
+def test_serve001_versioned_keys_and_writes_are_clean():
+    good_direct = (
+        "def f(self, digest):\n"
+        "    return self._cache[(self._version, digest)]\n"
+    )
+    good_traced = (
+        "def f(self, version, digest):\n"
+        "    key = (version, digest)\n"
+        "    return self._cache.get(key)\n"
+    )
+    write_only = (
+        "def f(self, digest, probs):\n"
+        "    self._cache[digest] = probs\n"
+        "    del self._cache[digest]\n"
+    )
+    non_cache = (
+        "def f(self, digest):\n"
+        "    return self._index.get(digest)\n"
+    )
+    for src in (good_direct, good_traced, write_only, non_cache):
+        assert "SERVE001" not in rule_ids(
+            lint(src, path="fedcrack_tpu/serve/fixture.py")
+        ), src
+
+
+def test_serve001_scoped_to_serve_tree():
+    bad = "def f(cache, k):\n    return cache[k]\n"
+    assert "SERVE001" in rule_ids(lint(bad, path="fedcrack_tpu/serve/fx.py"))
+    assert "SERVE001" not in rule_ids(lint(bad, path="fedcrack_tpu/fed/fx.py"))
+
+
 # ---- suppressions ----
 
 
@@ -664,6 +720,9 @@ def test_committed_lock_graph_artifact_is_current_and_acyclic():
         "fedcrack_tpu/serve/fleet.py::FleetVersionManager._lock",
         "fedcrack_tpu/serve/router.py::FleetRouter._lock",
         "fedcrack_tpu/serve/router.py::RollingPercentiles._lock",
+        # Round 19: the video-session manager's cross-session accounting
+        # lock (leaf — per-session state is single-handler by design).
+        "fedcrack_tpu/serve/stream.py::StreamSessionManager._lock",
     }
 
 

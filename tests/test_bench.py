@@ -223,6 +223,16 @@ def test_detail_schema_declares_contract_keys():
     assert {"replicas", "quant", "throughput_rps", "p95_ms"} <= set(
         bench.SERVE_FLEET_ARM_SCHEMA
     )
+    # Round-19 video-serving arm: the effective-throughput + identity keys
+    # BASELINE.md "Round 19" reads.
+    assert {
+        "effective_speedup",
+        "effective_img_per_s",
+        "speedup_target_met",
+        "identity",
+        "swap",
+        "metrics_in_exposition",
+    } <= set(bench.VIDEO_SERVING_SCHEMA)
     # The schema cannot drift from the code that writes the payload: every
     # declared key must appear as a literal in bench.py's emitting code.
     with open(bench.__file__) as f:
@@ -235,6 +245,7 @@ def test_detail_schema_declares_contract_keys():
         | set(bench.COMPRESSION_WIRE_SCHEMA)
         | set(bench.SERVE_FLEET_SCHEMA)
         | set(bench.SERVE_FLEET_ARM_SCHEMA)
+        | set(bench.VIDEO_SERVING_SCHEMA)
     ):
         assert f'"{key}"' in src, f"schema key {key!r} never written by bench.py"
 
@@ -673,3 +684,73 @@ def test_async_federation_schema_guard():
     assert any("updates_per_sec" in v for v in violations)
     summary = bench.compact_summary({"detail": good})
     assert "async_federation" in summary["sections"]
+
+
+def test_video_serving_schema_guard():
+    """Round-19 video-serving arm: error-arm exempt, a present arm fully
+    typed, mistyped values reported never crashed, and the compact summary
+    lists the section."""
+    bench = _import_bench()
+    good = {
+        "video_serving": {
+            "frame": {"size": 192, "frames": 20, "overlap_fraction": 0.9583},
+            "stateless": {"wall_s": 0.55, "img_per_s": 36.2},
+            "session": {"wall_s": 0.16, "img_per_s": 122.3, "hit_ratio": 0.74},
+            "effective_speedup": 4.43,
+            "effective_img_per_s": 160.5,
+            "speedup_target_met": True,
+            "identity": {"frames_checked": 20, "mismatches": 0, "ok": True},
+            "swap": {"frame": 13, "identity_after_swap": True},
+            "metrics_in_exposition": True,
+            "grpc_smoke": {"frames_dropped": 0, "audit": {"ok": True}},
+        }
+    }
+    assert bench.validate_detail(good) == []
+    assert bench.validate_detail({"video_serving": {"error": "boom"}}) == []
+    # grpc_smoke is nullable (the smoke must not void the in-process A/B).
+    nosmoke = dict(good["video_serving"], grpc_smoke=None)
+    assert bench.validate_detail({"video_serving": nosmoke}) == []
+    assert any(
+        "video_serving['identity'] missing" in v
+        for v in bench.validate_detail(
+            {"video_serving": {k: v for k, v in good["video_serving"].items() if k != "identity"}}
+        )
+    )
+    mistyped = dict(good["video_serving"], effective_speedup="fast")
+    assert any(
+        "video_serving['effective_speedup']" in v
+        for v in bench.validate_detail({"video_serving": mistyped})
+    )
+    summary = bench.compact_summary({"detail": good})
+    assert "video_serving" in summary["sections"]
+
+
+def test_committed_r19_artifact_video_serving_contract():
+    """The round-19 acceptance pin: the committed CPU-smoke artifact ran
+    every section (skipped == []), its cached-vs-stateless byte-identity
+    audit is green including across the mid-sequence hot swap, the
+    effective throughput model clears the >= 3x target at >= 90% overlap,
+    the serve_stream_* metrics reached the exposition, and the
+    StreamPredict gRPC smoke dropped nothing with the wire audit green."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "bench_runs", "r19_video_serving_cpu_smoke.json")
+    with open(path) as f:
+        art = json.load(f)
+    assert art["detail"]["skipped"] == []
+    video = art["detail"]["video_serving"]
+    assert "error" not in video
+    assert video["frame"]["overlap_fraction"] >= 0.9
+    assert video["effective_speedup"] >= 3.0
+    assert video["speedup_target_met"] is True
+    assert video["effective_img_per_s"] > video["stateless"]["img_per_s"]
+    identity = video["identity"]
+    assert identity["ok"] and identity["mismatches"] == 0
+    assert identity["frames_checked"] == video["frame"]["frames"]
+    swap = video["swap"]
+    assert swap["identity_after_swap"] and swap["full_rerun_on_swap"]
+    assert swap["stale_entries_purged"] > 0
+    assert video["metrics_in_exposition"] is True
+    smoke = video["grpc_smoke"]
+    assert "error" not in smoke
+    assert smoke["frames_dropped"] == 0 and smoke["stills_dropped"] == 0
+    assert smoke["audit"]["ok"] and smoke["audit"]["checked"] > 0

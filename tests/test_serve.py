@@ -659,6 +659,11 @@ def test_pb2_serving_descriptors_match_proto():
     for make, cls in [
         (regen_pb2._predict_request, pb.PredictRequest),
         (regen_pb2._predict_response, pb.PredictResponse),
+        (regen_pb2._stream_open, pb.StreamOpen),
+        (regen_pb2._stream_frame, pb.StreamFrame),
+        (regen_pb2._stream_close, pb.StreamClose),
+        (regen_pb2._stream_request, pb.StreamRequest),
+        (regen_pb2._stream_response, pb.StreamResponse),
     ]:
         want = make()
         have = cls.DESCRIPTOR
@@ -670,12 +675,32 @@ def test_pb2_serving_descriptors_match_proto():
     method = svc.methods_by_name["Predict"]
     assert method.input_type is pb.PredictRequest.DESCRIPTOR
     assert method.output_type is pb.PredictResponse.DESCRIPTOR
+    stream = svc.methods_by_name["StreamPredict"]
+    assert stream.input_type is pb.StreamRequest.DESCRIPTOR
+    assert stream.output_type is pb.StreamResponse.DESCRIPTOR
+    # Bidi: session requests stream in, per-frame responses stream out.
+    want_stream = {
+        m.name: (m.client_streaming, m.server_streaming)
+        for m in regen_pb2._serve_plane().method
+    }
+    assert want_stream["StreamPredict"] == (True, True)
+    # StreamRequest's oneof keeps open/frame/close mutually exclusive.
+    assert [o.name for o in pb.StreamRequest.DESCRIPTOR.oneofs] == ["msg"]
 
     proto_path = os.path.join(os.path.dirname(regen_pb2.__file__), "transport.proto")
     with open(proto_path) as f:
         text = f.read()
     assert "service ServePlane" in text
-    for msg in (regen_pb2._predict_request(), regen_pb2._predict_response()):
+    assert "rpc StreamPredict(stream StreamRequest) returns (stream StreamResponse)" in text
+    for msg in (
+        regen_pb2._predict_request(),
+        regen_pb2._predict_response(),
+        regen_pb2._stream_open(),
+        regen_pb2._stream_frame(),
+        regen_pb2._stream_close(),
+        regen_pb2._stream_request(),
+        regen_pb2._stream_response(),
+    ):
         assert f"message {msg.name}" in text
         for field in msg.field:
             assert re.search(

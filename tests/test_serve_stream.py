@@ -1,0 +1,571 @@
+"""Frame-coherent video serving (round 19): the per-stream tile cache, the
+crack tracker, stream chaos, and the StreamPredict gRPC front door.
+
+The load-bearing claim, pinned from four directions here:
+
+- **byte identity**: a cached session's per-frame probs equal
+  ``engine.predict_tiled`` bit-for-bit at every motion fraction (0, 0.1,
+  0.5, 1.0 — all-hits through all-misses), across a cache reset, with the
+  cache disabled, under an LRU bound, and for the frame that straddles a
+  live hot swap (the version-in-key invalidation);
+- **accounting**: static frames compute zero tiles, full-noise frames
+  compute all of them, a swap/reset frame is a clean full re-run;
+- **tracker**: contour ids are stable under slow motion, growth is
+  monotone on a growing blob, and unseen tracks retire after ``miss_ttl``;
+- **front door**: load_gen's ``--profile video`` drives open/frames/close
+  over the real socket with the wire-level stateless audit green, and
+  malformed opens are rejected 1:1 without killing the session RPC.
+"""
+
+import json
+import queue
+import threading
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.serve
+
+TINY_KW = dict(
+    img_size=32, stem_features=4, encoder_features=(8,), decoder_features=(8, 4)
+)
+BUCKETS = (16, 32)
+FRAME = 64
+
+
+@pytest.fixture(scope="module")
+def stack():
+    """One compiled engine + two weight versions shared by the module."""
+    import jax
+
+    from fedcrack_tpu.configs import ModelConfig, ServeConfig
+    from fedcrack_tpu.models.resunet import init_variables
+    from fedcrack_tpu.serve import InferenceEngine
+
+    model_config = ModelConfig(**TINY_KW)
+    serve_config = ServeConfig(
+        bucket_sizes=BUCKETS, max_batch=4, max_delay_ms=10.0, tile_overlap=4
+    )
+    engine = InferenceEngine(model_config, serve_config)
+    var0 = init_variables(jax.random.key(0), model_config)
+    var1 = init_variables(jax.random.key(1), model_config)
+    return engine, var0, var1
+
+
+class _Static:
+    """Weights source pinned to one version (the no-swap arm)."""
+
+    def __init__(self, version, variables):
+        self._snap = (version, variables)
+
+    def snapshot(self):
+        return self._snap
+
+
+class _SwapAfter:
+    """Weights source that installs v1 immediately AFTER handing out v0 for
+    the ``at``-th snapshot — the swap lands while that frame computes, so
+    the frame itself must stay entirely on v0 (one snapshot per frame) and
+    the NEXT frame must be a full re-run on v1."""
+
+    def __init__(self, var0, var1, at):
+        self.var0, self.var1, self.at = var0, var1, at
+        self.calls = 0
+
+    def snapshot(self):
+        self.calls += 1
+        if self.calls <= self.at:
+            return 0, self.var0
+        return 1, self.var1
+
+
+def _frames(n, motion_fraction, seed=0, size=FRAME):
+    from fedcrack_tpu.tools.load_gen import make_frame_sequence
+
+    return make_frame_sequence(n, size, motion_fraction, seed=seed)
+
+
+# ---- the tentpole contract: cached == stateless, byte for byte ----
+
+
+@pytest.mark.parametrize("motion", [0.0, 0.1, 0.5, 1.0])
+def test_motion_sweep_byte_identity(stack, motion):
+    """Seeded property sweep over the motion fraction: whatever mix of
+    cached and computed tiles serves a frame, the bytes equal stateless
+    ``predict_tiled`` — and the cache accounting matches the geometry at
+    the extremes (0.0 = all hits after frame 0, 1.0 = never a hit)."""
+    from fedcrack_tpu.serve.stream import StreamSession
+
+    engine, var0, _ = stack
+    # 128 px over 32 px tiles (5 tile rows): at 64 px a mid-fraction moving
+    # band can straddle ALL 3 tile rows and the accounting claim vanishes.
+    size = 2 * FRAME
+    session = StreamSession(engine, _Static(0, var0), height=size, width=size)
+    frames = _frames(6, motion, seed=int(motion * 10), size=size)
+    steady = []
+    for i, frame in enumerate(frames):
+        result = session.process_frame(frame)
+        assert result.probs.tobytes() == np.asarray(
+            engine.predict_tiled(var0, frame)
+        ).tobytes(), f"motion={motion} frame={i}"
+        if i == 0:
+            assert result.full_rerun and result.cache_hits == 0
+        else:
+            steady.append(result)
+        if i > 0 and motion == 0.0:
+            assert result.tiles_computed == 0
+            assert result.cache_hits == result.tiles_total
+        if i > 0 and motion == 1.0:
+            # Every row rewritten with fresh noise: no tile survives.
+            assert result.tiles_computed == result.tiles_total
+    if 0.0 < motion < 1.0:
+        computed = sum(r.tiles_computed for r in steady)
+        total = sum(r.tiles_total for r in steady)
+        assert 0 < computed < total, f"motion={motion}: {computed}/{total}"
+
+
+def test_frame_straddling_hot_swap_byte_identity(stack):
+    """The swap lands while frame ``at-1`` is computing: that frame answers
+    entirely from v0 (the one-snapshot barrier), the next frame pins v1,
+    finds every cached key unreachable (version is IN the key), purges the
+    stale entries, and full-re-runs to bytes identical to stateless v1."""
+    from fedcrack_tpu.serve.stream import StreamSession
+
+    engine, var0, var1 = stack
+    at = 3
+    session = StreamSession(
+        engine, _SwapAfter(var0, var1, at), height=FRAME, width=FRAME
+    )
+    frames = _frames(5, 0.1, seed=42)
+    for i, frame in enumerate(frames):
+        result = session.process_frame(frame)
+        want_vars = var0 if i < at else var1
+        assert result.model_version == (0 if i < at else 1)
+        assert result.probs.tobytes() == np.asarray(
+            engine.predict_tiled(want_vars, frame)
+        ).tobytes(), f"frame={i}"
+        if i == at:
+            assert result.full_rerun and result.cache_hits == 0
+            assert result.evicted > 0  # v0 entries purged, not served
+
+
+def test_static_sequence_computes_zero_tiles_after_first(stack):
+    from fedcrack_tpu.serve.stream import StreamSession
+
+    engine, var0, _ = stack
+    session = StreamSession(engine, _Static(0, var0), height=FRAME, width=FRAME)
+    frame = _frames(1, 0.0)[0]
+    first = session.process_frame(frame)
+    assert first.tiles_computed == first.tiles_total
+    for _ in range(3):
+        again = session.process_frame(frame)
+        assert again.tiles_computed == 0
+        assert again.probs.tobytes() == first.probs.tobytes()
+
+
+def test_reset_forces_full_rerun_same_bytes(stack):
+    from fedcrack_tpu.serve.stream import StreamSession
+
+    engine, var0, _ = stack
+    session = StreamSession(engine, _Static(0, var0), height=FRAME, width=FRAME)
+    frame = _frames(1, 0.0, seed=5)[0]
+    before = session.process_frame(frame)
+    assert session.process_frame(frame).tiles_computed == 0
+    session.reset()
+    assert session.cache_len() == 0
+    after = session.process_frame(frame)
+    assert after.full_rerun and after.tiles_computed == after.tiles_total
+    assert after.probs.tobytes() == before.probs.tobytes()
+
+
+def test_cache_disabled_escape_hatch(stack):
+    """cache_tiles=0 is the full re-run escape hatch: nothing is ever
+    cached, every frame recomputes everything, bytes unchanged."""
+    from fedcrack_tpu.serve.stream import StreamSession
+
+    engine, var0, _ = stack
+    session = StreamSession(
+        engine, _Static(0, var0), height=FRAME, width=FRAME, cache_tiles=0
+    )
+    for frame in _frames(3, 0.0, seed=6):
+        result = session.process_frame(frame)
+        assert result.full_rerun
+        assert result.tiles_computed == result.tiles_total
+        assert session.cache_len() == 0
+        assert result.probs.tobytes() == np.asarray(
+            engine.predict_tiled(var0, frame)
+        ).tobytes()
+
+
+def test_lru_bound_evicts_but_never_changes_bytes(stack):
+    from fedcrack_tpu.serve.stream import StreamSession
+
+    engine, var0, _ = stack
+    session = StreamSession(
+        engine, _Static(0, var0), height=FRAME, width=FRAME, cache_tiles=3
+    )
+    evicted = 0
+    for frame in _frames(4, 0.5, seed=7):
+        result = session.process_frame(frame)
+        evicted += result.evicted
+        assert session.cache_len() <= 3
+        assert result.probs.tobytes() == np.asarray(
+            engine.predict_tiled(var0, frame)
+        ).tobytes()
+    assert evicted > 0
+
+
+def test_undersized_frame_pads_like_predict_tiled(stack):
+    """A session smaller than the largest bucket takes the same zero-pad
+    route as predict_tiled — identity must hold there too."""
+    from fedcrack_tpu.serve.stream import StreamSession
+
+    engine, var0, _ = stack
+    session = StreamSession(engine, _Static(0, var0), height=24, width=24)
+    rng = np.random.default_rng(8)
+    for _ in range(2):
+        frame = rng.integers(0, 256, (24, 24, 3), dtype=np.uint8)
+        result = session.process_frame(frame)
+        assert result.probs.shape == (24, 24, 1)
+        assert result.probs.tobytes() == np.asarray(
+            engine.predict_tiled(var0, frame)
+        ).tobytes()
+
+
+def test_session_input_validation(stack):
+    from fedcrack_tpu.serve.stream import StreamSession
+
+    engine, var0, _ = stack
+    session = StreamSession(engine, _Static(0, var0), height=FRAME, width=FRAME)
+    with pytest.raises(ValueError, match="frame shape"):
+        session.process_frame(np.zeros((32, 64, 3), np.uint8))
+    with pytest.raises(ValueError, match="channels"):
+        session.process_frame(np.zeros((FRAME, FRAME, 1), np.uint8))
+    with pytest.raises(ValueError, match="uint8"):
+        session.process_frame(np.zeros((FRAME, FRAME, 3), np.float32))
+
+
+# ---- temporal layer: EMA smoothing + crack tracking ----
+
+
+def test_smoothing_never_touches_the_raw_contract(stack):
+    """EMA probs are a separate output; result.probs stays stateless-
+    identical with smoothing on."""
+    from fedcrack_tpu.serve.stream import StreamSession
+
+    engine, var0, _ = stack
+    session = StreamSession(
+        engine, _Static(0, var0), height=FRAME, width=FRAME, smooth_alpha=0.7
+    )
+    frames = _frames(3, 0.1, seed=9)
+    for frame in frames:
+        result = session.process_frame(frame)
+        assert result.smoothed is not None
+        assert result.smoothed.shape == result.probs.shape
+        assert result.probs.tobytes() == np.asarray(
+            engine.predict_tiled(var0, frame)
+        ).tobytes()
+
+
+def _blob_mask(size, cx, cy, r):
+    yy, xx = np.mgrid[0:size, 0:size]
+    return (((yy - cy) ** 2 + (xx - cx) ** 2) <= r * r).astype(np.uint8) * 255
+
+
+def test_tracker_stable_ids_and_growth():
+    """A blob drifting 2 px/frame and growing keeps ONE track id, its
+    area_growth_px is positive, and a vanished blob retires after
+    miss_ttl frames."""
+    from fedcrack_tpu.serve.stream import CrackTracker
+
+    tracker = CrackTracker(match_dist=8.0, miss_ttl=2)
+    ids = set()
+    last = None
+    for t in range(4):
+        tracks = tracker.update(_blob_mask(64, 20 + 2 * t, 20, 5 + t), t)
+        assert len(tracks) == 1
+        ids.add(tracks[0]["id"])
+        last = tracks[0]
+    assert len(ids) == 1
+    assert last["area_growth_px"] > 0
+    # Blob disappears: the track survives miss_ttl-1 empty frames, then
+    # retires.
+    empty = np.zeros((64, 64), np.uint8)
+    tracker.update(empty, 4)
+    assert any(t["id"] in ids for t in tracker.snapshot())
+    tracker.update(empty, 5)
+    assert not any(t["id"] in ids for t in tracker.snapshot())
+
+
+def test_tracker_new_blob_gets_new_id():
+    from fedcrack_tpu.serve.stream import CrackTracker
+
+    tracker = CrackTracker(match_dist=5.0)
+    first = tracker.update(_blob_mask(64, 16, 16, 4), 0)
+    both = tracker.update(
+        np.maximum(_blob_mask(64, 16, 16, 4), _blob_mask(64, 48, 48, 4)), 1
+    )
+    assert len(first) == 1 and len(both) == 2
+    assert len({t["id"] for t in both}) == 2
+    assert first[0]["id"] in {t["id"] for t in both}
+
+
+def test_tracker_validation_and_json():
+    from fedcrack_tpu.serve.stream import CrackTracker, tracks_to_json
+
+    with pytest.raises(ValueError, match="match_dist"):
+        CrackTracker(match_dist=0.0)
+    with pytest.raises(ValueError, match="miss_ttl"):
+        CrackTracker(match_dist=1.0, miss_ttl=0)
+    tracker = CrackTracker(match_dist=5.0)
+    tracks = tracker.update(_blob_mask(32, 10, 10, 3), 0)
+    parsed = json.loads(tracks_to_json(tracks))
+    assert parsed == json.loads(tracks_to_json(tracks))  # deterministic
+    assert parsed[0]["id"] == tracks[0]["id"]
+
+
+def test_session_tracking_through_frames(stack):
+    from fedcrack_tpu.serve.stream import StreamSession
+
+    engine, var0, _ = stack
+    session = StreamSession(
+        engine, _Static(0, var0), height=FRAME, width=FRAME, track=True
+    )
+    result = session.process_frame(_frames(1, 0.0, seed=11)[0])
+    assert isinstance(result.tracks, list)
+
+
+# ---- chaos: the SERVE_STREAM_RESET fault ----
+
+
+def test_chaos_stream_reset_fires_once_and_keeps_bytes(stack):
+    from fedcrack_tpu.chaos.inject import StreamChaos
+    from fedcrack_tpu.chaos.plan import SERVE_STREAM_RESET, Fault, FaultPlan
+    from fedcrack_tpu.obs.registry import MetricsRegistry
+    from fedcrack_tpu.serve.stream import StreamSession, StreamSessionManager
+
+    engine, var0, _ = stack
+    registry = MetricsRegistry()
+    manager = StreamSessionManager(engine, _Static(0, var0), registry=registry)
+    plan = FaultPlan([Fault(kind=SERVE_STREAM_RESET, round=2)])
+    manager.chaos = StreamChaos(plan, manager=manager)
+    session = StreamSession(
+        engine,
+        _Static(0, var0),
+        height=FRAME,
+        width=FRAME,
+        chaos=manager.chaos,
+    )
+    frame = _frames(1, 0.0, seed=12)[0]
+    results = [session.process_frame(frame) for _ in range(4)]
+    assert [r.full_rerun for r in results] == [True, False, True, False]
+    assert len(plan.triggered) == 1
+    assert sum(registry.values()["serve_stream_resets_total"].values()) == 1
+    assert all(r.probs.tobytes() == results[0].probs.tobytes() for r in results)
+
+
+def test_chaos_plan_generates_stream_kind():
+    from fedcrack_tpu.chaos.plan import SERVE_STREAM_RESET, FaultPlan
+
+    plan = FaultPlan.generate(
+        3, n_rounds=6, clients=(), kinds=(SERVE_STREAM_RESET,), n_faults=4
+    )
+    assert all(f.kind == SERVE_STREAM_RESET for f in plan.pending)
+    assert all(0 <= f.round < 6 for f in plan.pending)
+
+
+# ---- the session manager: bounds + serve_stream_* metrics ----
+
+
+def test_manager_bounds_and_metrics_exposition(stack):
+    from fedcrack_tpu.obs.registry import MetricsRegistry
+    from fedcrack_tpu.serve.stream import StreamSessionManager
+
+    engine, var0, _ = stack
+    registry = MetricsRegistry()
+    manager = StreamSessionManager(
+        engine, _Static(0, var0), max_sessions=2, registry=registry
+    )
+    session = manager.open("a", height=FRAME, width=FRAME)
+    manager.open("b", height=FRAME, width=FRAME)
+    with pytest.raises(ValueError, match="already open"):
+        manager.open("a", height=FRAME, width=FRAME)
+    with pytest.raises(ValueError, match="bound"):
+        manager.open("c", height=FRAME, width=FRAME)
+    assert manager.open_sessions() == 2
+    assert manager.close("b") is not None
+    assert manager.close("b") is None
+    assert manager.get("a") is session
+
+    for frame in _frames(2, 0.0, seed=13):
+        manager.record(session.process_frame(frame))
+    stats = manager.stats()
+    assert stats["tiles_total"] > 0
+    assert stats["hit_ratio"] > 0
+    assert stats["effective_speedup"] > 1.0
+    expo = registry.exposition()
+    for name in (
+        "serve_stream_sessions_total",
+        "serve_stream_frames_total",
+        "serve_stream_cache_hits_total",
+        "serve_stream_cache_misses_total",
+        "serve_stream_cache_evictions_total",
+        "serve_stream_full_rerun_total",
+        "serve_stream_resets_total",
+        "serve_stream_frame_seconds",
+        "serve_stream_cache_hit_ratio",
+        "serve_stream_effective_speedup_ratio",
+    ):
+        assert name in expo, name
+
+
+def test_stream_config_validation():
+    from fedcrack_tpu.configs import ServeConfig
+
+    with pytest.raises(ValueError, match="stream_cache_tiles"):
+        ServeConfig(stream_cache_tiles=-1)
+    with pytest.raises(ValueError, match="stream_max_sessions"):
+        ServeConfig(stream_max_sessions=0)
+    with pytest.raises(ValueError, match="stream_track_match_frac"):
+        ServeConfig(stream_track_match_frac=0.0)
+
+
+# ---- the gRPC front door ----
+
+
+@pytest.fixture(scope="module")
+def grpc_stack(stack):
+    from fedcrack_tpu.serve import (
+        MicroBatcher,
+        ModelVersionManager,
+        ServeServer,
+        ServeServerThread,
+        ServeService,
+    )
+    from fedcrack_tpu.serve.stream import StreamSessionManager
+
+    engine, var0, _ = stack
+    mgr = ModelVersionManager(engine, var0)
+    batcher = MicroBatcher(engine, mgr, max_delay_ms=5.0)
+    stream_manager = StreamSessionManager(engine, mgr, max_sessions=4)
+    server = ServeServer(
+        ServeService(engine, batcher, mgr, stream_manager=stream_manager),
+        port=0,
+    )
+    with ServeServerThread(server) as thread:
+        yield thread.port, mgr, stream_manager
+    batcher.close()
+    mgr.stop()
+
+
+def test_front_door_video_profile_end_to_end(grpc_stack):
+    """load_gen --profile video over the real socket: mixed still + video
+    traffic, zero drops, and the wire-level stateless byte audit green."""
+    from fedcrack_tpu.tools.load_gen import run_load
+
+    port, _, _ = grpc_stack
+    summary = run_load(
+        f"127.0.0.1:{port}",
+        profile="video",
+        n_requests=4,
+        concurrency=2,
+        sizes=(32,),
+        seed=0,
+        streams=2,
+        frames_per_stream=5,
+        motion_fraction=0.1,
+        video_size=FRAME,
+        audit_every=2,
+    )
+    assert summary["mode"] == "video"
+    assert summary["completed"] == 4 and summary["dropped"] == 0
+    video = summary["video"]
+    assert video["frames_completed"] == 10 and video["dropped"] == 0
+    assert video["open_failed"] == 0
+    assert video["audit"]["checked"] > 0 and video["audit"]["ok"]
+    assert video["hit_ratio"] > 0
+    assert video["effective_speedup"] > 1.0
+
+
+def test_front_door_rejects_bad_opens_without_killing_rpc(grpc_stack):
+    """One response per message even on rejection: bad channels and a
+    duplicate open are REJECTED, the stream stays usable, and close acks."""
+    import grpc
+
+    from fedcrack_tpu.tools.load_gen import _frame_chunks, _video_call, pb
+
+    port, _, _ = grpc_stack
+    frame = np.zeros((FRAME, FRAME, 3), np.uint8)
+    msgs = [
+        pb.StreamRequest(
+            stream_id="t",
+            open=pb.StreamOpen(height=FRAME, width=FRAME, channels=2),
+        ),
+        pb.StreamRequest(
+            stream_id="t", open=pb.StreamOpen(height=FRAME, width=FRAME)
+        ),
+        pb.StreamRequest(
+            stream_id="t", open=pb.StreamOpen(height=FRAME, width=FRAME)
+        ),
+        *_frame_chunks("t", 0, frame, chunk_bytes=1 << 20, crc=True),
+        pb.StreamRequest(stream_id="ghost", frame=pb.StreamFrame(frame_id=9)),
+        pb.StreamRequest(stream_id="t", close=pb.StreamClose()),
+    ]
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    try:
+        got = list(_video_call(channel)(iter(msgs)))
+    finally:
+        channel.close()
+    assert [r.status for r in got] == [
+        "REJECTED",  # channels=2
+        "OK",        # open
+        "REJECTED",  # duplicate open on the same call
+        "OK",        # the frame
+        "REJECTED",  # frame for a never-opened stream
+        "OK",        # close
+    ]
+    assert got[1].title == "OPENED" and got[-1].title == "CLOSED"
+    assert got[3].full_rerun and got[3].tiles_computed == got[3].tiles_total
+    assert len(got[3].mask) == FRAME * FRAME
+
+
+def test_front_door_session_slots_released_when_rpc_ends(grpc_stack):
+    """A dropped connection cannot leak sessions toward the bound."""
+    import grpc
+
+    from fedcrack_tpu.tools.load_gen import _video_call, pb
+
+    port, _, stream_manager = grpc_stack
+    before = stream_manager.open_sessions()
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    try:
+        call = _video_call(channel)
+        q: "queue.Queue" = queue.Queue()
+
+        def gen():
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                yield item
+
+        q.put(
+            pb.StreamRequest(
+                stream_id="leaky",
+                open=pb.StreamOpen(height=FRAME, width=FRAME),
+            )
+        )
+        it = call(gen())
+        assert next(it).status == "OK"
+        assert stream_manager.open_sessions() == before + 1
+        q.put(None)  # end the RPC without a Close message
+        with pytest.raises(StopIteration):
+            next(it)
+    finally:
+        channel.close()
+    deadline = threading.Event()
+    for _ in range(50):
+        if stream_manager.open_sessions() == before:
+            break
+        deadline.wait(0.05)
+    assert stream_manager.open_sessions() == before
