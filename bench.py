@@ -117,6 +117,10 @@ FEDCRACK_BENCH_OBSERVABILITY=0 (skip the round-15 concurrent mini-soak)
 FEDCRACK_BENCH_SOAK_S=8 (the soak's traffic wall in seconds)
 FEDCRACK_BENCH_HEALTH=0 (skip the round-18 federation-health drill,
 detail.federation_health)
+FEDCRACK_BENCH_LOWP=0 (skip the round-20 low-precision kernel A/B,
+detail.lowp_kernels) FEDCRACK_BENCH_LOWP_IMG=64 (its bucket size)
+FEDCRACK_BENCH_LOWP_CALLS=2 (predict calls at the short length; the long
+length is FIT_FACTOR x this)
 """
 
 from __future__ import annotations
@@ -177,6 +181,7 @@ DETAIL_SCHEMA: dict = {
     "observability": dict,
     "federation_health": dict,
     "video_serving": dict,
+    "lowp_kernels": dict,
 }
 # Typed keys of detail.observability (round 15): the concurrent mini-soak's
 # contract — the self-scrape must cover all five instrumented planes and
@@ -379,6 +384,33 @@ VIDEO_SERVING_SCHEMA: dict = {
     "swap": dict,
     "metrics_in_exposition": bool,
     "grpc_smoke": (dict, type(None)),
+}
+# Typed keys of detail.lowp_kernels (round 20): the kernel-plane A/B — the
+# r17 reference plane (dequantize-then-matmul in XLA) vs the fused-int8
+# Pallas plane (dequant fused into the matmul's K loop; the Pallas
+# INTERPRETER off-TPU) vs fp8 where the backend has the dtypes, on the
+# round-5 interleaved two-length template. Off-TPU the artifact's value is
+# the parity + gate columns (twin correctness); the timing columns become
+# a perf claim only on a real TPU (ROADMAP TPU measurement item 10).
+LOWP_KERNELS_SCHEMA: dict = {
+    "img": int,
+    "interpret_mode": bool,
+    "fp8_supported": bool,
+    "flops_per_forward_canonical": (int, float),
+    "impls": dict,
+    "speedup_vs_reference": dict,
+}
+# Per-variant keys of detail.lowp_kernels.impls.*. `parity_max_abs_diff`
+# is vs the reference plane's probabilities on the same probe batch (0.0
+# for the reference arm by construction); `gate` is the r17 two-phase
+# install gate's full verdict for THIS plane's program.
+LOWP_IMPL_SCHEMA: dict = {
+    "round_s_short": (int, float),
+    "round_s_long": (int, float),
+    "per_step_ms": (int, float, type(None)),
+    "mfu": (int, float, type(None)),
+    "parity_max_abs_diff": (int, float),
+    "gate": dict,
 }
 # Per-point keys of detail.reference_scale.* and the per-arm dicts of
 # detail.segmented_pipeline.*: the staging/overlap decomposition contract.
@@ -591,6 +623,38 @@ def validate_detail(detail: dict) -> list:
                 bad.append(f"video_serving[{key!r}] missing")
             elif not isinstance(video[key], typs):
                 bad.append(f"video_serving[{key!r}]: {type(video[key]).__name__}")
+    lowp = detail.get("lowp_kernels")
+    if isinstance(lowp, dict) and "error" not in lowp:
+        for key, typs in LOWP_KERNELS_SCHEMA.items():
+            if key not in lowp:
+                bad.append(f"lowp_kernels[{key!r}] missing")
+            elif not isinstance(lowp[key], typs):
+                bad.append(f"lowp_kernels[{key!r}]: {type(lowp[key]).__name__}")
+        impls = lowp.get("impls")
+        if isinstance(impls, dict) and not impls:
+            bad.append("lowp_kernels['impls'] is empty")
+        for name, point in (impls if isinstance(impls, dict) else {}).items():
+            if not isinstance(point, dict):
+                # Report, never TypeError — the r12 wire-map contract.
+                bad.append(f"lowp_kernels.impls[{name!r}]: {type(point).__name__}")
+                continue
+            for key, typs in LOWP_IMPL_SCHEMA.items():
+                if key not in point:
+                    bad.append(f"lowp_kernels.impls[{name!r}][{key!r}] missing")
+                elif not isinstance(point[key], typs):
+                    bad.append(
+                        f"lowp_kernels.impls[{name!r}][{key!r}]: "
+                        f"{type(point[key]).__name__}"
+                    )
+        if isinstance(impls, dict) and len(impls) >= 2:
+            speed = lowp.get("speedup_vs_reference")
+            if isinstance(speed, dict):
+                for name, val in speed.items():
+                    if not isinstance(val, (int, float)):
+                        bad.append(
+                            f"lowp_kernels.speedup_vs_reference[{name!r}]: "
+                            f"{type(val).__name__}"
+                        )
     return bad
 
 # Default sized from measured section costs on the TPU-tunnel host (round 4):
@@ -658,6 +722,16 @@ ASYNC_SEED = int(os.environ.get("FEDCRACK_BENCH_ASYNC_SEED", "0"))
 # breach → flight dump → exit-3 verdict. Host + tiny engine, seconds.
 # "0" opts out.
 HEALTH = os.environ.get("FEDCRACK_BENCH_HEALTH", "1") == "1"
+
+# Low-precision kernel A/B (round 20, detail.lowp_kernels): the quantized
+# predict program per kernel plane — reference (the r17 dequantize-then-
+# matmul XLA program), fused_int8 (the Pallas dequant-fused plane; the
+# interpreter off-TPU), fp8 where the backend has the dtypes — interleaved
+# on the r5 two-length template, plus per-plane numerics parity and the
+# install gate's verdict. Tiny engine off-TPU, seconds. "0" opts out.
+LOWP = os.environ.get("FEDCRACK_BENCH_LOWP", "1") == "1"
+LOWP_IMG = int(os.environ.get("FEDCRACK_BENCH_LOWP_IMG", "64"))
+LOWP_CALLS = int(os.environ.get("FEDCRACK_BENCH_LOWP_CALLS", "2"))
 
 # Serving-plane SLO section (round 10, detail.serving): boots the full
 # serve stack in-process (engine + micro-batcher + hot-swap manager + gRPC
@@ -1362,6 +1436,186 @@ def _layout_ab(
     del si_long, sm_long
     if checkpoint is not None:
         checkpoint()
+
+
+def _bench_lowp_kernels(device, skips: list) -> dict | None:
+    """Low-precision kernel-plane A/B (round 20, detail.lowp_kernels).
+
+    One quantized model, one predict program per kernel plane: the r17
+    reference (dequantize the int8 codes, then matmul in XLA), the
+    round-20 fused-int8 Pallas plane (dequant fused into the matmul's K
+    loop — the Pallas INTERPRETER off-TPU: numerics-true, wall-clock-
+    meaningless there), and the fp8 plane where the backend has fp8
+    dtypes. Discipline is the round-5 Pallas-BCE A/B: every variant's
+    engine is built over the SAME weights, timed at two call counts with
+    the variants' reps INTERLEAVED so drift hits all arms equally, slope =
+    per-forward time; MFU is charged on canonical reference-topology FLOPs
+    (obs/flops.py — bit-width changes bytes per MAC, not MACs).
+
+    Each variant additionally records its numerics parity vs the reference
+    plane's probabilities and the r17 two-phase install gate's verdict for
+    ITS program — off-TPU those columns ARE the artifact's value (twin
+    correctness, measured not assumed); the timing columns only become a
+    perf claim on a real TPU (ROADMAP TPU measurement item 10). Variants
+    are budget-gated individually; fp8 absence on this backend is recorded
+    as ``fp8_supported: false``, not a skip (ambient truth, not a budget
+    decision). A gate refusal is an honest artifact, not a failure.
+    """
+    import dataclasses
+
+    from fedcrack_tpu import jaxcompat
+    from fedcrack_tpu.configs import ModelConfig, ServeConfig
+    from fedcrack_tpu.obs.flops import mfu, resunet_forward_flops
+    from fedcrack_tpu.models.resunet import init_variables
+    from fedcrack_tpu.serve import quant as quant_mod
+    from fedcrack_tpu.serve.engine import InferenceEngine
+
+    on_tpu = getattr(device, "platform", "") == "tpu"
+    img = LOWP_IMG
+    if on_tpu:
+        model_config = ModelConfig(img_size=img, compute_dtype="bfloat16")
+    else:
+        # The interpreter executes kernel bodies in Python — the full-width
+        # model would burn minutes proving nothing this one doesn't.
+        model_config = ModelConfig(
+            img_size=img,
+            stem_features=8,
+            encoder_features=(16, 32),
+            decoder_features=(32, 16),
+        )
+    base_cfg = ServeConfig(
+        bucket_sizes=(img,),
+        max_batch=4,
+        max_delay_ms=5.0,
+        tile_overlap=0,
+        quant="int8",
+    )
+    variables = init_variables(jax.random.key(SEED), model_config)
+    batch = quant_mod.probe_images(img, 4, SEED)
+    fp8_ok = bool(jaxcompat.fp8_supported())
+    variants = ["reference", "fused_int8"] + (["fp8"] if fp8_ok else [])
+
+    k_short = max(1, LOWP_CALLS)
+    k_long = FIT_FACTOR * k_short
+
+    # Per-variant build + gate + warm, reference first (it is the parity
+    # oracle AND the speedup denominator — without it the section has no
+    # comparison, so the first budget gate prices TWO variants). Later
+    # variants are priced off the first one's measured cost (the
+    # self-correcting-estimate pattern of _layout_ab).
+    runners: dict[str, tuple] = {}
+    impls: dict[str, dict] = {}
+    probs_ref = None
+    variant_est = COMPILE_EST_S + 10.0
+    measured_variant_s = None
+    for variant in variants:
+        est = variant_est if measured_variant_s is None else measured_variant_s
+        if not _fits(est * (1 if runners else 2)):
+            _skip(
+                skips,
+                f"lowp_kernels_{variant}",
+                est,
+                "estimate exceeds remaining budget",
+            )
+            continue
+        t0v = time.monotonic()
+        cfg_v = dataclasses.replace(base_cfg, kernel_plane=variant)
+        engine = InferenceEngine(model_config, cfg_v)
+        ref_payload = engine.prepare(variables)
+        q_payload = engine.prepare_quantized(
+            quant_mod.quantize_for_plane(variables, engine.effective_kernel_plane)
+        )
+        gate = quant_mod.quant_gate(engine, ref_payload, q_payload)
+
+        def run_calls(n, _engine=engine, _q=q_payload):
+            for _ in range(n):
+                _engine.predict_bucket(_q, batch)
+
+        probs = engine.predict_bucket(q_payload, batch)  # warm + parity sample
+        t0c = time.perf_counter()
+        run_calls(1)  # second warm call — the committed-signature path
+        per_call_hint = time.perf_counter() - t0c
+        if variant == "reference":
+            probs_ref = probs
+        parity = (
+            0.0
+            if variant == "reference"
+            else float(
+                np.max(
+                    np.abs(
+                        np.asarray(probs, np.float64)
+                        - np.asarray(probs_ref, np.float64)
+                    )
+                )
+            )
+        )
+        impls[variant] = {
+            "parity_max_abs_diff": parity,
+            "gate": gate.to_json(),
+            "effective_kernel_plane": engine.effective_kernel_plane,
+        }
+        runners[variant] = run_calls
+        build_warm_s = time.monotonic() - t0v
+        measured_variant_s = (
+            build_warm_s + REPS * (k_short + k_long) * per_call_hint
+        )
+
+    if len(runners) < 2:
+        for variant in runners:
+            _skip(
+                skips,
+                "lowp_kernels",
+                variant_est,
+                "fewer than 2 variants funded; no comparison possible",
+            )
+        return None
+
+    # Interleaved timed reps: one short pass over all variants, then one
+    # long pass, per rep — drift lands on every variant equally.
+    shorts: dict[str, list] = {v: [] for v in runners}
+    longs: dict[str, list] = {v: [] for v in runners}
+    for _ in range(REPS):
+        for v, run_calls in runners.items():
+            shorts[v].append(_median_time(lambda r=run_calls: r(k_short), 1))
+        for v, run_calls in runners.items():
+            longs[v].append(_median_time(lambda r=run_calls: r(k_long), 1))
+
+    flops = resunet_forward_flops(model_config, int(batch.shape[0]))
+    for v in runners:
+        short_s = float(np.median(shorts[v]))
+        long_s = float(np.median(longs[v]))
+        slope = (long_s - short_s) / (k_long - k_short)
+        fit_ok = slope > 0.0
+        util = mfu(slope, flops, device) if fit_ok else None
+        impls[v].update(
+            round_s_short=short_s,
+            round_s_long=long_s,
+            per_step_ms=round(slope * 1e3, 4) if fit_ok else None,
+            mfu=None if util is None else round(util, 4),
+        )
+    ref = impls.get("reference", {})
+    speedup = {}
+    if ref.get("per_step_ms"):
+        speedup = {
+            v: round(ref["per_step_ms"] / p["per_step_ms"], 4)
+            for v, p in impls.items()
+            if v != "reference" and p.get("per_step_ms")
+        }
+    return {
+        "img": img,
+        "interpret_mode": not on_tpu,
+        "fp8_supported": fp8_ok,
+        "calls_short": k_short,
+        "calls_long": k_long,
+        "flops_per_forward_canonical": flops,
+        "impls": impls,
+        "speedup_vs_reference": speedup,
+        "note": (
+            "MFU charged on canonical reference-topology FLOPs for every "
+            "plane; off-TPU the fused arms run the Pallas interpreter — "
+            "parity + gate columns are the claim there, timing is not"
+        ),
+    }
 
 
 def _measure_input_pipeline(img: int) -> dict | None:
@@ -3433,6 +3687,23 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
             _skip(
                 skips, "video_serving", video_est, "estimate exceeds remaining budget"
             )
+
+    # ---- low-precision kernels (round 20): the kernel-plane A/B —
+    # reference vs fused-int8 (interpreter off-TPU) vs fp8-where-supported
+    # quantized predict on the r5 interleaved template, with per-plane
+    # parity + install-gate verdicts. Tiny engine: host-scale seconds
+    # off-TPU; the function budget-gates its variants individually ----
+    if LOWP:
+        t0 = time.monotonic()
+        try:
+            lowp_point = _bench_lowp_kernels(device, skips)
+            if lowp_point is not None:
+                detail["lowp_kernels"] = lowp_point
+        except Exception as e:  # never kills the artifact
+            detail["lowp_kernels"] = {"error": repr(e)}
+        section_s["lowp_kernels"] = time.monotonic() - t0
+        detail["budget"] = _budget_detail()
+        _set_payload(metric_headline, value, vs_baseline, detail)
 
     # ---- layout A/B (round 6): the VERDICT r5 top ask — space-to-depth /
     # channel-packing graph transforms vs the reference layout, interleaved,

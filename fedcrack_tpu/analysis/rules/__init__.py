@@ -18,6 +18,7 @@ def all_rules() -> list[Rule]:
         determinism,
         durability,
         health_plane,
+        kernel_plane,
         locks,
         obs_plane,
         serve_plane,
@@ -28,7 +29,7 @@ def all_rules() -> list[Rule]:
     out: list[Rule] = []
     for pack in (
         determinism, durability, trace, transport, compress, async_plane,
-        obs_plane, health_plane, locks, deadcode, serve_plane,
+        obs_plane, health_plane, locks, deadcode, serve_plane, kernel_plane,
     ):
         out.extend(cls() for cls in pack.RULES)
     return out

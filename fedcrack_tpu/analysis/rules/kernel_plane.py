@@ -1,0 +1,97 @@
+"""Kernel-plane rule pack (round 20).
+
+- **KERN001 pallas_call without a CPU twin**: every Pallas kernel in this
+  repo must be testable off-TPU. The contract (ops/pallas_bce.py, round 5;
+  kernels/dequant.py, round 20) is that the module owning a
+  ``pl.pallas_call`` ships a twin of the compiled kernel that runs on any
+  backend, in one of two idiomatic forms:
+
+  * an **interpret-mode path** — some ``pallas_call`` site in the module
+    takes an ``interpret=`` keyword, so the same kernel body runs under the
+    Pallas interpreter on CPU and can be pinned against a reference;
+  * a **reference twin** — a function in the module whose name carries
+    ``reference``/``_ref``/``jnp`` implementing the same math in plain XLA.
+
+  A module that compiles a kernel without either is untestable until a TPU
+  shows up: its numerics can silently drift from the math the rest of the
+  codebase assumes (the exact failure class the round-17 quant gate exists
+  to catch at install time, and the round-20 property sweeps catch in CI).
+  The rule fires one ERROR per ``pallas_call`` site in such a module.
+
+  Matching is by idiom, not import graph: any call spelled
+  ``<anything>.pallas_call(...)`` or a bare ``pallas_call(...)`` counts as
+  a kernel launch; docstring mentions and attribute reads without a call do
+  not fire.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from fedcrack_tpu.analysis.engine import Finding, ModuleSource, Rule, Severity
+
+# Function-name fragments that mark a plain-XLA reference twin of a kernel.
+_TWIN_NAME_FRAGMENTS = ("reference", "_ref", "jnp")
+
+
+def _is_pallas_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr == "pallas_call"
+    if isinstance(func, ast.Name):
+        return func.id == "pallas_call"
+    return False
+
+
+def _has_interpret_kwarg(call: ast.Call) -> bool:
+    return any(kw.arg == "interpret" for kw in call.keywords)
+
+
+def _module_has_twin(module: ModuleSource) -> bool:
+    """True when the module ships a CPU twin for its kernels: an
+    ``interpret=`` keyword on any pallas_call site, or a function whose
+    name marks a plain-XLA reference implementation."""
+    for node in ast.walk(module.tree):
+        if _is_pallas_call(node) and _has_interpret_kwarg(node):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            low = node.name.lower()
+            if any(frag in low for frag in _TWIN_NAME_FRAGMENTS):
+                return True
+    return False
+
+
+class PallasKernelWithoutTwinRule(Rule):
+    id = "KERN001"
+    severity = Severity.ERROR
+    description = (
+        "pallas_call in a module with neither an interpret-mode path "
+        "(interpret= kwarg on some pallas_call site) nor a reference twin "
+        "function — the kernel is untestable off-TPU and its numerics can "
+        "drift unpinned"
+    )
+    paths = ("/fedcrack_tpu/",)
+
+    def check(self, module: ModuleSource) -> Iterable[Finding]:
+        has_twin: bool | None = None  # computed lazily; most modules have 0 kernels
+        for node in ast.walk(module.tree):
+            if not _is_pallas_call(node):
+                continue
+            if has_twin is None:
+                has_twin = _module_has_twin(module)
+            if has_twin:
+                return
+            yield self.finding(
+                module,
+                node,
+                "pallas_call without a CPU twin in this module: add an "
+                "interpret= kwarg threaded to an interpreter path (the "
+                "ops/pallas_bce.py idiom) or a plain-XLA reference "
+                "function, and pin them against each other in tests",
+            )
+
+
+RULES = (PallasKernelWithoutTwinRule,)

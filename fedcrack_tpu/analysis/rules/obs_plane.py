@@ -43,7 +43,11 @@ from fedcrack_tpu.analysis.engine import Finding, ModuleSource, Rule, Severity
 
 METRIC_METHODS = ("counter", "gauge", "histogram")
 NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
-UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_ratio", "_versions", "_replicas")
+# Keep in lockstep with obs.registry.UNIT_SUFFIXES (runtime half of the
+# same contract); `_info` is round 20's constant-1 labeled info-gauge unit.
+UNIT_SUFFIXES = (
+    "_seconds", "_bytes", "_total", "_ratio", "_versions", "_replicas", "_info",
+)
 
 
 def _registry_receiver(call: ast.Call) -> bool:
@@ -76,8 +80,8 @@ class MetricCatalogNameRule(Rule):
     description = (
         "registry.counter/gauge/histogram metric name must be a snake_case "
         "string literal with a unit suffix (_seconds/_bytes/_total/_ratio/"
-        "_versions/_replicas) — computed or free-spelled names break the "
-        "greppable catalog and the exposition's stability"
+        "_versions/_replicas/_info) — computed or free-spelled names break "
+        "the greppable catalog and the exposition's stability"
     )
 
     def check(self, module: ModuleSource) -> Iterable[Finding]:

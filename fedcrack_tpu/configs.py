@@ -151,6 +151,19 @@ class ServeConfig:
     # unquantized program — never a silent accuracy cliff.
     quant: str = "none"
     quant_iou_floor: float = 0.98
+    # ---- Low-precision kernel plane (round 20, fedcrack_tpu/kernels/) ----
+    # Which program body the quantized predict path compiles:
+    #   "reference"  — r17's dequantize-in-graph + model.apply (the default);
+    #   "fused_int8" — Pallas fused dequant-matmul forward: int8 codes feed
+    #                  the MXU directly, f32 accumulation, no f32 weight
+    #                  tensor ever materialized;
+    #   "fp8"        — same fused forward over fp8 e4m3 codes; a backend
+    #                  without fp8 support degrades to "reference" (the r17
+    #                  path) bit-exactly at engine build time.
+    # Every non-reference plane still requires quant="int8" and installs
+    # ONLY through the r17 quant_gate — a failing probe refuses loudly and
+    # the fleet keeps serving the reference program.
+    kernel_plane: str = "reference"
     # Optional activation fake-quant at the program boundary (dynamic
     # per-tensor symmetric int8 of the pre-sigmoid logits). Weight-only
     # quantization needs no calibration data; this flag measures the
@@ -228,6 +241,17 @@ class ServeConfig:
         if not 0.0 < self.quant_iou_floor <= 1.0:
             raise ValueError(
                 f"quant_iou_floor must be in (0, 1], got {self.quant_iou_floor}"
+            )
+        if self.kernel_plane not in ("reference", "fused_int8", "fp8"):
+            raise ValueError(
+                "kernel_plane must be 'reference', 'fused_int8' or 'fp8', "
+                f"got {self.kernel_plane!r}"
+            )
+        if self.kernel_plane != "reference" and self.quant != "int8":
+            raise ValueError(
+                f"kernel_plane={self.kernel_plane!r} requires quant='int8' — "
+                "the fused planes consume the quantized tree and ride its "
+                "install gate"
             )
         if self.quant_probe_batch < 1:
             raise ValueError(

@@ -155,6 +155,50 @@ def ensure_cpu_devices(n: int | None = None) -> None:
             pass
 
 
+def fp8_dtypes():
+    """``(weight_dtype, grad_dtype)`` — fp8 e4m3 for weights, e5m2 for
+    gradients (the Micikevicius et al. split the kernel plane follows) —
+    or ``None`` where this jax build ships neither."""
+    import jax.numpy as jnp
+
+    e4m3 = getattr(jnp, "float8_e4m3fn", None)
+    e5m2 = getattr(jnp, "float8_e5m2", None)
+    if e4m3 is None or e5m2 is None:  # pragma: no cover - ancient jax
+        return None
+    return (e4m3, e5m2)
+
+
+_FP8_PROBE: bool | None = None
+
+
+def fp8_supported() -> bool:
+    """Whether fp8 codes actually round-trip on this backend (dtypes exist
+    AND a tiny cast runs) — probed once, cached. The kernel plane resolves
+    ``kernel_plane="fp8"`` through this: unsupported degrades to the r17
+    int8 reference path bit-exactly (engine.py). Tests monkeypatch this
+    function to pin the degraded path, so callers must resolve it
+    DYNAMICALLY (``jaxcompat.fp8_supported()``, never a cached import)."""
+    global _FP8_PROBE
+    if _FP8_PROBE is None:
+        dts = fp8_dtypes()
+        if dts is None:  # pragma: no cover - ancient jax
+            _FP8_PROBE = False
+        else:
+            try:
+                import jax.numpy as jnp
+                import numpy as np
+
+                got = np.asarray(
+                    jnp.asarray([1.0, -2.5], jnp.float32)
+                    .astype(dts[0])
+                    .astype(jnp.float32)
+                )
+                _FP8_PROBE = bool(np.all(np.isfinite(got)))
+            except Exception:  # pragma: no cover - backend refuses fp8
+                _FP8_PROBE = False
+    return _FP8_PROBE
+
+
 def is_distributed_initialized() -> bool:
     """Whether this process runs inside an initialized jax.distributed job.
     Reads ``jax.distributed.is_initialized`` dynamically (monkeypatchable);

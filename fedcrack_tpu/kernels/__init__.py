@@ -1,0 +1,21 @@
+"""Low-precision kernel plane (round 20): Pallas fused dequantize kernels.
+
+``dequant`` holds the shape-level primitives — a fused dequant-matmul that
+feeds int8/fp8 codes to the MXU directly (dequant folded into the load,
+float32 accumulation) plus the elementwise dequant twin the training-side
+fake-quant transform rides. ``forward`` assembles them into the full fused
+ResUNet inference forward that consumes ``serve/quant.py``'s quantized
+variables pytree without materializing the float32 weights.
+
+Selection is a serve-plane policy knob (``ServeConfig.kernel_plane``), wired
+through ``serve/engine.py`` so every fused program installs through the r17
+``quant_gate`` — a numerically-bad kernel refuses loudly and the fleet keeps
+serving the reference program.
+"""
+
+from fedcrack_tpu.kernels.dequant import (  # noqa: F401
+    default_impl,
+    dequant_codes,
+    dequant_matmul,
+    fake_quant_params,
+)

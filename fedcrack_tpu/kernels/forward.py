@@ -1,0 +1,151 @@
+"""Fused ResUNet inference forward over the quantized variables pytree.
+
+The model has no single conv chokepoint (models/resunet.py is a Flax module
+graph), so the fused plane re-expresses each conv in matmul form and routes
+it through :func:`~fedcrack_tpu.kernels.dequant.dequant_matmul` — the int8/
+fp8 codes reach the contraction directly, no float32 weight tensor is ever
+materialized:
+
+- 3x3 convs (stem, decoder ConvTranspose): im2col via
+  ``lax.conv_general_dilated_patches``. Patch channels are (C, kh, kw)-major,
+  so the HWIO kernel reshapes as ``transpose(2,0,1,3).reshape(C*9, F)`` —
+  per-output-channel scales ride along unchanged (F stays last). A 3x3
+  stride-1 SAME ``nn.ConvTranspose`` computes exactly the plain SAME conv of
+  the same HWIO kernel (verified bit-exact on this jax), so the decoder needs
+  no transposed-conv kernel.
+- 1x1 convs (pointwise, decoder residuals, head): plain reshape + matmul.
+- encoder residual 1x1 stride-2: a SAME 1x1 stride-2 conv reads exactly the
+  ``x[:, ::2, ::2]`` pixels — slice then matmul (bit-exact re-expression).
+- depthwise 3x3 (SeparableConv stage 1): O(9*C) weights — nothing to gain
+  from fusing the dequant into a grouped conv; expands via ``dequant_codes``
+  and runs the stock grouped conv (documented limitation, charged honestly).
+
+Pool/upsample reuse the model's own ops (``max_pool_auto``/``upsample2x``),
+BatchNorm applies running statistics inline. Everything accumulates in
+float32 regardless of the serve compute dtype — the plane trades weight
+bandwidth, not accumulation width.
+
+Parity contract: this forward is a numerical TWIN of the r17 reference
+program (dequantize + ``model.apply``), not a bitwise one — BN folding and
+matmul-order reassociation move single ulps. The install-time ``quant_gate``
+holds it to the same probe-IoU floor as any quantized program, and
+tests/test_kernels.py pins per-layer twin error bounds.
+
+Layout limitation: only the reference parameter layouts are supported —
+``stem_layout``/``res_layout`` transforms derive folded kernels in-forward
+from float32 weights, which contradicts never-materialize; the engine
+refuses the combination at build time.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from fedcrack_tpu.configs import ModelConfig
+from fedcrack_tpu.kernels.dequant import dequant_codes, dequant_matmul
+from fedcrack_tpu.models.resunet import _BN_EPSILON, upsample2x
+from fedcrack_tpu.ops.pooling import max_pool_auto
+
+_DIMS = ("NHWC", "HWIO", "NHWC")
+
+
+def _codes(leaf) -> tuple[jax.Array, jax.Array]:
+    from fedcrack_tpu.serve.quant import QKEY, QKEY_FP8, SKEY
+
+    if not isinstance(leaf, dict):
+        raise TypeError(
+            f"fused forward wants quantized kernel leaves, got {type(leaf).__name__}"
+        )
+    return leaf[QKEY] if QKEY in leaf else leaf[QKEY_FP8], leaf[SKEY]
+
+
+def _bn(x, p, s):
+    inv = p["scale"] * lax.rsqrt(s["var"] + _BN_EPSILON)
+    return (x - s["mean"]) * inv + p["bias"]
+
+
+def _conv1x1(x, mod, impl):
+    q, s = _codes(mod["kernel"])  # (1, 1, C, F)
+    n, h, w, c = x.shape
+    y = dequant_matmul(x.reshape(n * h * w, c), q.reshape(c, -1), s, impl=impl)
+    return y.reshape(n, h, w, -1) + mod["bias"]
+
+
+def _conv3x3(x, mod, *, stride, impl):
+    q, s = _codes(mod["kernel"])  # (3, 3, C, F)
+    c, f = q.shape[2], q.shape[3]
+    patches = lax.conv_general_dilated_patches(
+        x, (3, 3), (stride, stride), "SAME", dimension_numbers=_DIMS
+    )
+    n, ho, wo, _ = patches.shape
+    q2 = jnp.transpose(q, (2, 0, 1, 3)).reshape(c * 9, f)
+    y = dequant_matmul(patches.reshape(n * ho * wo, c * 9), q2, s, impl=impl)
+    return y.reshape(n, ho, wo, f) + mod["bias"]
+
+
+def _sepconv(x, mod, impl):
+    dq, ds = _codes(mod["depthwise"]["kernel"])  # (3, 3, 1, C)
+    kern = dequant_codes(dq, ds, impl="reference")
+    x = lax.conv_general_dilated(
+        x,
+        kern,
+        (1, 1),
+        "SAME",
+        feature_group_count=x.shape[-1],
+        dimension_numbers=_DIMS,
+    )
+    return _conv1x1(x, mod["pointwise"], impl)
+
+
+def fused_predict_logits(
+    qtree, x: jax.Array, config: ModelConfig, *, impl: str | None = None
+) -> jax.Array:
+    """Per-pixel logits from the quantized tree — the fused twin of
+    ``model.apply(dequantize_variables(qtree), x, train=False)``.
+
+    ``qtree``: the ``{'params', 'batch_stats'}`` pytree produced by
+    ``quantize_variables`` / ``quantize_variables_fp8`` (bare tree, not the
+    ``QuantizedVariables`` wrapper). ``impl`` threads to every fused matmul
+    (``dequant.default_impl()`` when None)."""
+    if config.stem_layout != "reference" or config.res_layout != "reference":
+        raise ValueError(
+            "fused kernel planes support only the reference parameter layouts; "
+            f"got stem_layout={config.stem_layout!r} res_layout={config.res_layout!r}"
+        )
+    p, st = qtree["params"], qtree["batch_stats"]
+    x = x.astype(jnp.float32)
+
+    x = _conv3x3(x, p["stem_conv"], stride=2, impl=impl)
+    x = _bn(x, p["stem_bn"], st["stem_bn"])
+    x = jax.nn.relu(x)
+    prev = x
+
+    for i in range(len(config.encoder_features)):
+        x = jax.nn.relu(x)
+        x = _sepconv(x, p[f"enc{i}_sep1"], impl)
+        x = _bn(x, p[f"enc{i}_bn1"], st[f"enc{i}_bn1"])
+        x = jax.nn.relu(x)
+        x = _sepconv(x, p[f"enc{i}_sep2"], impl)
+        x = _bn(x, p[f"enc{i}_bn2"], st[f"enc{i}_bn2"])
+        x = max_pool_auto(x)
+        # Reference residual: Conv(F, 1x1, stride 2) — reads the ::2 pixels.
+        x = x + _conv1x1(prev[:, ::2, ::2, :], p[f"enc{i}_res"], impl)
+        prev = x
+
+    for i in range(len(config.decoder_features)):
+        x = jax.nn.relu(x)
+        x = _conv3x3(x, p[f"dec{i}_convT1"], stride=1, impl=impl)
+        x = _bn(x, p[f"dec{i}_bn1"], st[f"dec{i}_bn1"])
+        x = jax.nn.relu(x)
+        x = _conv3x3(x, p[f"dec{i}_convT2"], stride=1, impl=impl)
+        x = _bn(x, p[f"dec{i}_bn2"], st[f"dec{i}_bn2"])
+        # Residual conv + add at the LOW resolution (resunet.py's commute).
+        x = x + _conv1x1(prev, p[f"dec{i}_res"], impl)
+        if i + 1 < len(config.decoder_features):
+            x = upsample2x(x)
+            prev = x
+
+    logits = _conv1x1(x, p["head"], impl)
+    return upsample2x(logits)

@@ -22,6 +22,13 @@ would inflate "achieved FLOP/s" for the transformed variants. Every
 layout is charged the REFERENCE topology's FLOPs, so an A/B's MFU column
 moves only when wall-clock does (the honesty requirement of bench.py's
 layout A/B; pinned by tests/test_flops.py).
+
+The same discipline covers the round-20 kernel planes
+(``ServeConfig.kernel_plane``): a fused-int8 or fp8 forward changes bytes
+moved and bit-width per MAC, not canonical MACs — every plane is charged
+the reference topology's FLOPs so bf16-vs-int8-vs-fp8 MFU columns stay
+comparable. Which plane actually answered is exported separately as the
+``serve_kernel_plane_info`` labeled gauge (:func:`export_kernel_plane`).
 """
 
 from __future__ import annotations
@@ -144,3 +151,29 @@ def mfu(step_time_s: float, flops_per_step: float, device: jax.Device | None = N
     if peak is None or step_time_s <= 0.0:
         return None
     return (flops_per_step / step_time_s) / peak
+
+
+def export_kernel_plane(
+    effective: str, *, requested: str | None = None, registry=None
+) -> None:
+    """Export which kernel plane answers quantized traffic as the
+    ``serve_kernel_plane_info`` labeled gauge (Prometheus info-metric idiom:
+    constant 1, state in the labels). The ``requested`` label keeps an
+    fp8-request-degraded-to-reference visible in a scrape; earlier states'
+    series drop to 0 so exactly one ``plane`` reads 1."""
+    from fedcrack_tpu.obs.registry import REGISTRY
+
+    reg = registry if registry is not None else REGISTRY
+    fam = reg.gauge(
+        "serve_kernel_plane_info",
+        "which quantized-predict kernel plane is compiled in (constant-1 "
+        "info gauge; plane=effective program body, requested=the "
+        "ServeConfig ask — they differ when fp8 degraded to the r17 "
+        "reference path on a backend without fp8 support)",
+        labels=("plane", "requested"),
+    )
+    req = requested if requested is not None else effective
+    for key, child in fam._series():
+        if key != (effective, req):
+            child.set(0)
+    fam.labels(plane=effective, requested=req).set(1)

@@ -49,10 +49,14 @@ _LABEL_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
 
 # The unit vocabulary OBS001 pins (ISSUE r15): the issue's four suffixes
 # plus `_versions`, the async plane's staleness unit (a staleness histogram
-# measures model-version lag, not seconds or bytes), and `_replicas`
+# measures model-version lag, not seconds or bytes), `_replicas`
 # (round 17: the serve fleet's live-worker count — a population gauge,
-# not a monotone total).
-UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_ratio", "_versions", "_replicas")
+# not a monotone total), and `_info` (round 20: the Prometheus info-metric
+# idiom — a constant-1 gauge whose LABELS carry categorical state, e.g.
+# which kernel plane answers quantized traffic).
+UNIT_SUFFIXES = (
+    "_seconds", "_bytes", "_total", "_ratio", "_versions", "_replicas", "_info",
+)
 
 # Latency-shaped default buckets (Prometheus client defaults extended to
 # 30 s — a federation flush on a loaded CPU host can take seconds).

@@ -123,7 +123,10 @@ def _host_view(x) -> np.ndarray | None:
     return np.asarray(x, np.float32)
 
 
-def _epoch_runner(tx, apply_fn, inner_axis, n_inner, anchor, mu_arr, pw_arr):
+def _epoch_runner(
+    tx, apply_fn, inner_axis, n_inner, anchor, mu_arr, pw_arr,
+    weight_transform=None,
+):
     """The per-client local-fit core, shared OP FOR OP by the monolithic
     round (``_build_round``) and the epoch-segmented variant
     (``_build_round_segments``): returns ``run_epochs(carry, chunks,
@@ -135,6 +138,14 @@ def _epoch_runner(tx, apply_fn, inner_axis, n_inner, anchor, mu_arr, pw_arr):
     single-chunk call is exactly the historical monolithic epoch body, and
     splitting one scan into consecutive scans with the carry threaded
     through is the identical step sequence (test-pinned).
+
+    ``weight_transform`` (round 20, the lowp twin): an optional traceable
+    map applied to the params INSIDE the loss — the forward computes with
+    ``weight_transform(params)`` (e.g. the straight-through int8 fake-quant
+    of ``kernels.dequant.fake_quant_params``) while the optimizer, FedProx
+    anchor and FedAvg all keep operating on the float32 master weights.
+    ``None`` leaves the traced program byte-identical to a pre-r20 build
+    (the conditional is Python-level — the codec-twin discipline).
     """
 
     def sgd_step(carry, batch):
@@ -145,7 +156,8 @@ def _epoch_runner(tx, apply_fn, inner_axis, n_inner, anchor, mu_arr, pw_arr):
         imgs, msks = as_model_batch(*batch)
 
         def loss_fn(p):
-            logits, new_stats = apply_fn(p, batch_stats, imgs)
+            p_eff = p if weight_transform is None else weight_transform(p)
+            logits, new_stats = apply_fn(p_eff, batch_stats, imgs)
             # One fused pass for BCE + all statistics (Pallas kernel on
             # TPU, XLA reference elsewhere — ops/pallas_bce.py).
             m = fused_segmentation_metrics(logits, msks, pos_weight=pw_arr)
@@ -307,6 +319,7 @@ def _build_round(
     data_placement: str = "streamed",
     update_codec: str | None = None,
     topk_fraction: float = 0.01,
+    lowp: str | None = None,
 ):
     """Shared core of the one-program federated round.
 
@@ -359,6 +372,24 @@ def _build_round(
     if not 0.0 < topk_fraction <= 1.0:
         raise ValueError(f"topk_fraction must be in (0, 1], got {topk_fraction}")
     topk = codec == "topk_delta"
+    # Low-precision training twin (round 20, kernels/dequant.py): the local
+    # fit's forward computes with straight-through int8 fake-quant weights —
+    # the same quantize/dequant math the fused serve plane loads — while the
+    # optimizer and FedAvg keep the float32 masters. Same null-build
+    # discipline as the codec: None/"null" leaves the traced program
+    # byte-identical to a pre-r20 build (Python-level conditional,
+    # test-pinned); monolithic-only, like the codec twin.
+    if lowp in (None, "null"):
+        lowp = "null"
+        weight_transform = None
+    elif lowp == "fake_quant_int8":
+        from fedcrack_tpu.kernels.dequant import fake_quant_params
+
+        weight_transform = fake_quant_params
+    else:
+        raise ValueError(
+            f"lowp must be None, 'null' or 'fake_quant_int8', got {lowp!r}"
+        )
 
     # `extra` is the codec's side channel: the P('clients')-sharded
     # error-feedback pytree for topk_delta, the replicated per-call seed
@@ -383,7 +414,8 @@ def _build_round(
         pw_arr = jnp.asarray(pw, jnp.float32)
 
         run_epochs = _epoch_runner(
-            tx, apply_fn, inner_axis, n_inner, anchor, mu_arr, pw_arr
+            tx, apply_fn, inner_axis, n_inner, anchor, mu_arr, pw_arr,
+            weight_transform=weight_transform,
         )
         # The carry becomes client-varying after the first data-dependent
         # update; promote the (replicated) initial carry so scan's carry type
@@ -579,6 +611,9 @@ def _build_round(
     # RoundRecord.bytes_per_round), and — for the topk twin — a reset hook
     # dropping the cross-round error-feedback state.
     round_fn.update_codec = codec
+    # Which low-precision training twin this round runs ("null" = the exact
+    # pre-r20 program).
+    round_fn.lowp = lowp
     round_fn.wire_bytes_per_client = None
     round_fn.reset_ef = lambda: ef_state.update(ef=None, calls=0)
     # Test hook: the device-resident EF pytree ([C, ...] per leaf), None
@@ -711,6 +746,7 @@ def build_federated_round(
     data_placement: str = "streamed",
     update_codec: str | None = None,
     topk_fraction: float = 0.01,
+    lowp: str | None = None,
 ):
     """Compile-once round function over ``Mesh(('clients', 'batch'))``.
 
@@ -758,6 +794,15 @@ def build_federated_round(
     tags ``update_codec`` and prices ``wire_bytes_per_client`` on first
     call for the driver's ``bytes_per_round`` counter. The codec twin is
     monolithic-only — ``build_federated_round_segments`` has no codec arg.
+
+    ``lowp`` (round 20): ``None``/``"null"`` leaves the program untouched
+    (byte-identical build, same discipline as the codec); ``"fake_quant_int8"``
+    runs every local-fit forward with straight-through int8 fake-quant
+    weights (``kernels.dequant.fake_quant_params`` — the quantize/dequant
+    math the fused serve plane loads), optimizer/anchor/FedAvg staying on
+    the float32 masters. Trajectory pinned within the r12 int8-mesh-twin
+    IoU tolerance vs the reference round (tests/test_kernels.py).
+    Monolithic-only, like the codec twin.
     """
     model_config = model_config or ModelConfig()
     _require_axes(mesh, CLIENTS, BATCH)
@@ -777,6 +822,7 @@ def build_federated_round(
         data_placement=data_placement,
         update_codec=update_codec,
         topk_fraction=topk_fraction,
+        lowp=lowp,
     )
 
 

@@ -131,6 +131,22 @@ class InferenceEngine:
         # the weight loads), and the optional activation fake-quant applies
         # at the logits boundary. Same canonical FLOPs as the reference
         # program (obs/flops) — int8 changes bytes moved, not MACs charged.
+        #
+        # Round 20: ServeConfig.kernel_plane selects the BODY of this
+        # program. The quant_gate calls predict_bucket with
+        # QuantizedVariables, which routes here — so whichever plane built
+        # _fn_q is exactly the program the gate probes, and a fused plane
+        # inherits the r17 install contract (IoU floor, loud bf16 refusal)
+        # with zero gate changes. "fp8" on a backend without fp8 support
+        # degrades to "reference" at build time: the SAME closure as r17,
+        # bit-exact by construction (test-pinned).
+        self.kernel_plane = self.serve_config.kernel_plane
+        self.effective_kernel_plane = self.kernel_plane
+        if self.kernel_plane == "fp8":
+            from fedcrack_tpu import jaxcompat
+
+            if not jaxcompat.fp8_supported():
+                self.effective_kernel_plane = "reference"
         self._fn_q = None
         if self.serve_config.quant == "int8":
             from fedcrack_tpu.serve.quant import (
@@ -140,12 +156,38 @@ class InferenceEngine:
 
             act_fq = self.serve_config.quant_act_fakequant
 
-            def _predict_q(qtree, images_u8):
-                x = normalize_images(images_u8)
-                logits = model.apply(dequantize_variables(qtree), x, train=False)
-                if act_fq:
-                    logits = fake_quant_activations(logits)
-                return jax.nn.sigmoid(logits).astype(jnp.float32)
+            if self.effective_kernel_plane == "reference":
+
+                def _predict_q(qtree, images_u8):
+                    x = normalize_images(images_u8)
+                    logits = model.apply(dequantize_variables(qtree), x, train=False)
+                    if act_fq:
+                        logits = fake_quant_activations(logits)
+                    return jax.nn.sigmoid(logits).astype(jnp.float32)
+
+            else:
+                from fedcrack_tpu.kernels.dequant import default_impl
+                from fedcrack_tpu.kernels.forward import fused_predict_logits
+
+                fused_config = self._bucket_model_config()
+                if (
+                    fused_config.stem_layout != "reference"
+                    or fused_config.res_layout != "reference"
+                ):
+                    raise ValueError(
+                        f"kernel_plane={self.kernel_plane!r} supports only the "
+                        "reference parameter layouts (kernels/forward.py); got "
+                        f"stem_layout={fused_config.stem_layout!r} "
+                        f"res_layout={fused_config.res_layout!r}"
+                    )
+                impl = default_impl()
+
+                def _predict_q(qtree, images_u8):
+                    x = normalize_images(images_u8)
+                    logits = fused_predict_logits(qtree, x, fused_config, impl=impl)
+                    if act_fq:
+                        logits = fake_quant_activations(logits)
+                    return jax.nn.sigmoid(logits).astype(jnp.float32)
 
             self._fn_q = jax.jit(_predict_q, **kwargs)
         self._max_batch = self.serve_config.max_batch

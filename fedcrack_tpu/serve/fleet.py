@@ -201,7 +201,15 @@ class FleetVersionManager:
         gate_record = None
         quant_by_engine: dict[int, Any] = {}
         if self.serve_config.quant == "int8":
-            qhost = quant_mod.quantize_variables(host_variables)
+            # Round 20: quantize in the code format the engines' kernel
+            # plane consumes (int8 for reference/fused_int8, e4m3 for fp8 —
+            # an fp8 request already degraded to reference at engine build
+            # where the backend lacks fp8). The gate below probes whichever
+            # program the plane compiled, unchanged.
+            plane = getattr(
+                next(iter(engines.values())), "effective_kernel_plane", "reference"
+            )
+            qhost = quant_mod.quantize_for_plane(host_variables, plane)
             quant_by_engine = {
                 eid: eng.prepare_quantized(qhost) for eid, eng in engines.items()
             }
@@ -227,8 +235,10 @@ class FleetVersionManager:
             self.last_quant_gate = gate_record
             if not gate.passed:
                 log.error(
-                    "int8 quantized build REFUSED: probe mask IoU %.4f < "
-                    "floor %.4f — fleet keeps serving the reference program",
+                    "quantized build (kernel_plane=%s) REFUSED: probe mask "
+                    "IoU %.4f < floor %.4f — fleet keeps serving the "
+                    "reference program",
+                    plane,
                     gate.iou,
                     gate.floor,
                 )
@@ -405,7 +415,7 @@ class ServeFleet:
             self.replicas, initial_variables, initial_version
         )
         if warmup:
-            from fedcrack_tpu.serve.quant import QuantizedVariables, quantize_variables
+            from fedcrack_tpu.serve.quant import QuantizedVariables, quantize_for_plane
 
             seen: set[int] = set()
             for r in self.replicas:
@@ -423,9 +433,24 @@ class ServeFleet:
                     else:
                         r.engine.warmup(
                             r.engine.prepare_quantized(
-                                quantize_variables(initial_variables)
+                                quantize_for_plane(
+                                    initial_variables,
+                                    getattr(
+                                        r.engine,
+                                        "effective_kernel_plane",
+                                        "reference",
+                                    ),
+                                )
                             )
                         )
+        # Which kernel plane answers quantized traffic — labeled info gauge
+        # (obs/flops.py) so a scrape can tell fused from reference serving.
+        from fedcrack_tpu.obs.flops import export_kernel_plane
+
+        export_kernel_plane(
+            getattr(self.engine, "effective_kernel_plane", "reference"),
+            requested=serve_config.kernel_plane,
+        )
         self.router = FleetRouter(
             self.replicas, serve_config, window_s=router_window_s
         )

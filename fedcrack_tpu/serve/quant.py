@@ -38,8 +38,15 @@ import numpy as np
 
 # Quantized leaves are dicts with exactly these keys; everything else in the
 # tree passes through untouched. A dict is a pytree, so the quantized tree
-# jits/device_puts like any variables tree.
+# jits/device_puts like any variables tree. Round 20 adds an fp8 leaf flavor
+# (e4m3 codes, same per-channel scale sidecar) for the kernel plane; a tree
+# holds ONE flavor, decided by the plane that quantized it.
 QKEY, SKEY = "int8_code", "scale"
+QKEY_FP8 = "fp8_code"
+
+# fp8 e4m3 (4 exponent / 3 mantissa bits): max finite magnitude 448 — the
+# symmetric-scale analog of int8's 127.
+FP8_E4M3_MAX = 448.0
 
 
 class QuantizedVariables:
@@ -56,7 +63,9 @@ class QuantizedVariables:
 
 
 def _is_qleaf(node: Any) -> bool:
-    return isinstance(node, dict) and set(node.keys()) == {QKEY, SKEY}
+    return isinstance(node, dict) and (
+        set(node.keys()) == {QKEY, SKEY} or set(node.keys()) == {QKEY_FP8, SKEY}
+    )
 
 
 def quantize_leaf(w: np.ndarray) -> dict:
@@ -88,14 +97,62 @@ def quantize_variables(variables: Any) -> QuantizedVariables:
     return QuantizedVariables(walk(variables, False))
 
 
+def quantize_leaf_fp8(w: np.ndarray) -> dict:
+    """Per-channel symmetric fp8 e4m3 codes + scales for one weight tensor
+    (Micikevicius et al.'s weight format: e4m3 for weights, e5m2 reserved for
+    gradients). Same scale discipline as :func:`quantize_leaf` with 448 (the
+    e4m3 finite max) in place of 127; all-zero channels get scale 1.0.
+    Raises where this jax build has no fp8 dtypes — callers resolve the
+    plane first (``jaxcompat.fp8_supported``)."""
+    from fedcrack_tpu.jaxcompat import fp8_dtypes
+
+    dts = fp8_dtypes()
+    if dts is None:
+        raise RuntimeError("this jax build has no fp8 dtypes")
+    w = np.asarray(w, np.float32)
+    absmax = np.max(np.abs(w), axis=tuple(range(w.ndim - 1)))
+    scale = np.where(absmax > 0, absmax / FP8_E4M3_MAX, 1.0).astype(np.float32)
+    code = np.asarray((w / scale), np.float32).astype(dts[0])
+    return {QKEY_FP8: code, SKEY: scale}
+
+
+def quantize_variables_fp8(variables: Any) -> QuantizedVariables:
+    """fp8 twin of :func:`quantize_variables`: same leaf selection (params,
+    ndim >= 2), e4m3 codes instead of int8."""
+
+    def walk(node, in_params: bool):
+        if isinstance(node, dict):
+            return {k: walk(v, in_params or k == "params") for k, v in node.items()}
+        arr = np.asarray(node)
+        if in_params and arr.ndim >= 2:
+            return quantize_leaf_fp8(arr)
+        return arr
+
+    return QuantizedVariables(walk(variables, False))
+
+
+def quantize_for_plane(variables: Any, kernel_plane: str) -> QuantizedVariables:
+    """The quantized tree a kernel plane consumes: int8 codes for
+    ``reference``/``fused_int8`` (the r17 format), e4m3 codes for ``fp8``.
+    Callers pass the engine's EFFECTIVE plane — an fp8 request on a backend
+    without fp8 support has already degraded to ``reference`` there, so the
+    tree and the compiled program always agree."""
+    if kernel_plane == "fp8":
+        return quantize_variables_fp8(variables)
+    if kernel_plane in ("reference", "fused_int8"):
+        return quantize_variables(variables)
+    raise ValueError(f"unknown kernel_plane {kernel_plane!r}")
+
+
 def dequantize_variables(qtree: Any) -> Any:
     """Inverse projection: the float32 tree the quantized program computes
     with. Traceable — called inside the jitted predict program, so XLA sees
-    int8 weight inputs and fuses the ``q * scale`` expansion."""
+    int8 (or fp8) weight inputs and fuses the ``q * scale`` expansion."""
 
     def walk(node):
         if _is_qleaf(node):
-            return node[QKEY].astype("float32") * node[SKEY]
+            code = node[QKEY] if QKEY in node else node[QKEY_FP8]
+            return code.astype("float32") * node[SKEY]
         if isinstance(node, dict):
             return {k: walk(v) for k, v in node.items()}
         return node

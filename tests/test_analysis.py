@@ -589,6 +589,64 @@ def test_serve001_scoped_to_serve_tree():
     assert "SERVE001" not in rule_ids(lint(bad, path="fedcrack_tpu/fed/fx.py"))
 
 
+# ---- kernel-plane pack ----
+
+
+def test_kern001_pallas_without_twin_positive_and_negative():
+    bad = (
+        "from jax.experimental import pallas as pl\n"
+        "def launch(x):\n"
+        "    return pl.pallas_call(_kernel, out_shape=o)(x)\n"
+    )
+    findings = lint(bad, path="fedcrack_tpu/kernels/fx.py")
+    assert "KERN001" in rule_ids(findings)
+    f = next(f for f in findings if f.rule == "KERN001")
+    assert f.severity is Severity.ERROR
+    # Twin form 1: an interpret= kwarg threaded to the interpreter path.
+    good_interpret = (
+        "from jax.experimental import pallas as pl\n"
+        "def launch(x, interpret=False):\n"
+        "    return pl.pallas_call(_kernel, out_shape=o, interpret=interpret)(x)\n"
+    )
+    assert "KERN001" not in rule_ids(
+        lint(good_interpret, path="fedcrack_tpu/kernels/fx.py")
+    )
+    # Twin form 2: a plain-XLA reference function alongside the launch.
+    good_reference = (
+        "from jax.experimental import pallas as pl\n"
+        "def _matmul_reference(x, w):\n"
+        "    return x @ w\n"
+        "def launch(x):\n"
+        "    return pl.pallas_call(_kernel, out_shape=o)(x)\n"
+    )
+    assert "KERN001" not in rule_ids(
+        lint(good_reference, path="fedcrack_tpu/kernels/fx.py")
+    )
+
+
+def test_kern001_fires_per_site_and_ignores_non_calls():
+    bad_two_sites = (
+        "from jax.experimental import pallas as pl\n"
+        "def a(x):\n"
+        "    return pl.pallas_call(_ka, out_shape=o)(x)\n"
+        "def b(x):\n"
+        "    return pl.pallas_call(_kb, out_shape=o)(x)\n"
+    )
+    findings = [
+        f
+        for f in lint(bad_two_sites, path="fedcrack_tpu/ops/fx.py")
+        if f.rule == "KERN001"
+    ]
+    assert len(findings) == 2
+    # Attribute reads and docstring mentions are not kernel launches.
+    quiet = (
+        '"""mentions pl.pallas_call in prose only."""\n'
+        "from jax.experimental import pallas as pl\n"
+        "launcher = pl.pallas_call\n"
+    )
+    assert "KERN001" not in rule_ids(lint(quiet, path="fedcrack_tpu/ops/fx.py"))
+
+
 # ---- suppressions ----
 
 

@@ -754,3 +754,81 @@ def test_committed_r19_artifact_video_serving_contract():
     assert "error" not in smoke
     assert smoke["frames_dropped"] == 0 and smoke["stills_dropped"] == 0
     assert smoke["audit"]["ok"] and smoke["audit"]["checked"] > 0
+
+
+def test_lowp_kernels_schema_guard():
+    """Round-20 lowp_kernels arm: declared in DETAIL_SCHEMA, its keys
+    written by bench.py, typed checks enforced, error-arm exempt, malformed
+    per-impl points reported — never a TypeError (the r12 wire-map
+    contract)."""
+    bench = _import_bench()
+    assert "lowp_kernels" in bench.DETAIL_SCHEMA
+    assert {"impls", "speedup_vs_reference", "interpret_mode"} <= set(
+        bench.LOWP_KERNELS_SCHEMA
+    )
+    assert {"parity_max_abs_diff", "gate"} <= set(bench.LOWP_IMPL_SCHEMA)
+    with open(bench.__file__) as f:
+        src = f.read()
+    for key in set(bench.LOWP_KERNELS_SCHEMA) | set(bench.LOWP_IMPL_SCHEMA):
+        assert f'"{key}"' in src, f"schema key {key!r} never written by bench.py"
+    impl = {
+        "round_s_short": 0.1,
+        "round_s_long": 0.4,
+        "per_step_ms": 10.0,
+        "mfu": 0.01,
+        "parity_max_abs_diff": 1e-6,
+        "gate": {"passed": True},
+    }
+    good = {
+        "lowp_kernels": {
+            "img": 64,
+            "interpret_mode": True,
+            "fp8_supported": True,
+            "flops_per_forward_canonical": 1e9,
+            "impls": {"reference": impl, "fused_int8": impl},
+            "speedup_vs_reference": {"fused_int8": 0.5},
+        }
+    }
+    assert bench.validate_detail(good) == []
+    assert bench.validate_detail({"lowp_kernels": {"error": "boom"}}) == []
+    empty = dict(good["lowp_kernels"], impls={})
+    assert any(
+        "impls" in v for v in bench.validate_detail({"lowp_kernels": empty})
+    )
+    broken = dict(
+        good["lowp_kernels"],
+        impls={"reference": impl, "fused_int8": {"gate": "nope"}},
+    )
+    bad = bench.validate_detail({"lowp_kernels": broken})
+    assert bad and all(isinstance(v, str) for v in bad)
+
+
+def test_committed_r20_artifact_lowp_kernels_contract():
+    """The round-20 acceptance pin: the committed CPU-smoke artifact ran
+    every section (skipped == []), both the reference and fused_int8 arms
+    were priced, the fused arm's interpret-mode twin matched the reference
+    program (tiny parity) and cleared the install gate, and the fp8 arm —
+    present exactly when the backend has fp8 dtypes — carries an honest
+    gate record either way (its pass/fail is a model-quality fact of the
+    tiny smoke model, not pinned here)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "bench_runs", "r20_lowp_kernels_cpu_smoke.json")
+    with open(path) as f:
+        art = json.load(f)
+    assert art["detail"]["skipped"] == []
+    lowp = art["detail"]["lowp_kernels"]
+    assert "error" not in lowp
+    impls = lowp["impls"]
+    assert {"reference", "fused_int8"} <= set(impls)
+    assert lowp["interpret_mode"] is True  # a CPU smoke runs the interpreter
+    assert impls["reference"]["parity_max_abs_diff"] == 0.0
+    fused = impls["fused_int8"]
+    assert fused["parity_max_abs_diff"] < 1e-3
+    assert fused["gate"]["passed"] is True
+    assert fused["effective_kernel_plane"] == "fused_int8"
+    assert ("fp8" in impls) == lowp["fp8_supported"]
+    if "fp8" in impls:
+        gate = impls["fp8"]["gate"]
+        assert isinstance(gate["passed"], bool) and 0.0 <= gate["iou"] <= 1.0
+    assert set(lowp["speedup_vs_reference"]) == set(impls) - {"reference"}
+    assert lowp["flops_per_forward_canonical"] > 0

@@ -102,3 +102,18 @@ def test_encoder_features_survive_json_as_tuples():
     assert back.model.encoder_features == (32, 64)
     assert isinstance(back.model.encoder_features, tuple)
     assert back == cfg
+
+
+def test_c16_lowp_kernels_preset_round_trips_with_kernel_plane():
+    """The round-20 low-precision serving preset: fused_int8 predict behind
+    the production install gate (IoU floor 0.98). kernel_plane must survive
+    the JSON round-trip — it travels in-band like every other knob — and
+    presets written before round 20 load with the "reference" default
+    (covered by the forward-compat test above)."""
+    path = os.path.join(ROOT, "configs", "c16_lowp_kernels.json")
+    with open(path) as f:
+        cfg = FedConfig.from_json(f.read())
+    assert cfg.serve.kernel_plane == "fused_int8"
+    assert cfg.serve.quant == "int8"  # fused planes require int8 sidecars
+    assert cfg.serve.quant_iou_floor == 0.98  # the production floor
+    assert FedConfig.from_json(cfg.to_json()) == cfg
