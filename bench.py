@@ -117,6 +117,8 @@ FEDCRACK_BENCH_OBSERVABILITY=0 (skip the round-15 concurrent mini-soak)
 FEDCRACK_BENCH_SOAK_S=8 (the soak's traffic wall in seconds)
 FEDCRACK_BENCH_HEALTH=0 (skip the round-18 federation-health drill,
 detail.federation_health)
+FEDCRACK_BENCH_ROBUST=0 (skip the round-21 robust-aggregation A/B drill,
+detail.robust_aggregation)
 FEDCRACK_BENCH_LOWP=0 (skip the round-20 low-precision kernel A/B,
 detail.lowp_kernels) FEDCRACK_BENCH_LOWP_IMG=64 (its bucket size)
 FEDCRACK_BENCH_LOWP_CALLS=2 (predict calls at the short length; the long
@@ -180,6 +182,7 @@ DETAIL_SCHEMA: dict = {
     "async_federation": dict,
     "observability": dict,
     "federation_health": dict,
+    "robust_aggregation": dict,
     "video_serving": dict,
     "lowp_kernels": dict,
 }
@@ -279,6 +282,41 @@ FEDERATION_HEALTH_WATCHDOG_SCHEMA: dict = {
     "flight_dumped": bool,
     "breach_exit_code": int,
     "would_exit": int,
+}
+# Typed keys of detail.robust_aggregation (round 21): the r18
+# SCALED_UPDATE scenario as a 4-arm A/B over real gRPC — identical
+# poisoned cohort, the only delta being FedConfig.aggregation /
+# quarantine_z — plus a 7-client colluding-minority variant and the
+# health-report join proving the quarantine exclusion is visible there.
+ROBUST_AGGREGATION_SCHEMA: dict = {
+    "scale_factor": (int, float),
+    "honest_mean": (int, float),
+    "reference_iou": (int, float),
+    "arms": dict,
+    "fedavg_cliffed": bool,
+    "robust_arms_hold": bool,
+    "drag_reduced_10x": bool,
+    "colluding": dict,
+    "health_report": dict,
+    "drill_s": (int, float),
+}
+# Keys every arm of detail.robust_aggregation.arms must carry (the
+# quarantine arm adds its NOT_WAIT-resync extras on top; robust arms add
+# drag_reduction_vs_fedavg — extras are allowed, absences are not).
+ROBUST_AGGREGATION_ARM_SCHEMA: dict = {
+    "aggregation": str,
+    "quarantine_z": (int, float),
+    "global_avg": (int, float),
+    "drag": (int, float),
+    "quarantined": dict,
+    "canary_iou": (int, float),
+    "serve_factor": (int, float),
+}
+ROBUST_AGGREGATION_HEALTH_SCHEMA: dict = {
+    "schema_violations": list,
+    "quarantines": int,
+    "quarantined_clients": list,
+    "exclusion_visible": bool,
 }
 # Typed keys of detail.async_federation (round 14): the buffered-async
 # contract — the chaos straggler-storm sync-vs-buffered A/B at equal wall,
@@ -596,6 +634,51 @@ def validate_detail(detail: dict) -> list:
                         f"federation_health.{block_key}[{key!r}]: "
                         f"{type(block[key]).__name__}"
                     )
+    robust = detail.get("robust_aggregation")
+    if isinstance(robust, dict) and "error" not in robust:
+        for key, typs in ROBUST_AGGREGATION_SCHEMA.items():
+            if key not in robust:
+                bad.append(f"robust_aggregation[{key!r}] missing")
+            elif not isinstance(robust[key], typs):
+                bad.append(
+                    f"robust_aggregation[{key!r}]: "
+                    f"{type(robust[key]).__name__}"
+                )
+        arms = robust.get("arms")
+        if isinstance(arms, dict):
+            for arm_name in sorted(arms):
+                arm = arms[arm_name]
+                if not isinstance(arm, dict):
+                    # Report, never TypeError: a non-dict arm is its own
+                    # violation, not a crash inside the validator.
+                    bad.append(
+                        f"robust_aggregation.arms[{arm_name!r}]: "
+                        f"{type(arm).__name__}"
+                    )
+                    continue
+                for key, typs in ROBUST_AGGREGATION_ARM_SCHEMA.items():
+                    if key not in arm:
+                        bad.append(
+                            f"robust_aggregation.arms[{arm_name!r}]"
+                            f"[{key!r}] missing"
+                        )
+                    elif not isinstance(arm[key], typs):
+                        bad.append(
+                            f"robust_aggregation.arms[{arm_name!r}]"
+                            f"[{key!r}]: {type(arm[key]).__name__}"
+                        )
+        hp = robust.get("health_report")
+        if isinstance(hp, dict):
+            for key, typs in ROBUST_AGGREGATION_HEALTH_SCHEMA.items():
+                if key not in hp:
+                    bad.append(
+                        f"robust_aggregation.health_report[{key!r}] missing"
+                    )
+                elif not isinstance(hp[key], typs):
+                    bad.append(
+                        f"robust_aggregation.health_report[{key!r}]: "
+                        f"{type(hp[key]).__name__}"
+                    )
     cohort = detail.get("cohort_scale")
     if isinstance(cohort, dict) and "error" not in cohort:
         for key, typs in COHORT_SCALE_SCHEMA.items():
@@ -722,6 +805,15 @@ ASYNC_SEED = int(os.environ.get("FEDCRACK_BENCH_ASYNC_SEED", "0"))
 # breach → flight dump → exit-3 verdict. Host + tiny engine, seconds.
 # "0" opts out.
 HEALTH = os.environ.get("FEDCRACK_BENCH_HEALTH", "1") == "1"
+
+# Robust-aggregation section (round 21, detail.robust_aggregation): the
+# SCALED_UPDATE scenario as a 4-arm A/B over real gRPC (fedavg /
+# trimmed_mean / krum / fedavg+quarantine — the only delta being
+# FedConfig.aggregation), the per-arm canary IoU on one shared tiny
+# engine, a 7-client colluding-minority variant, and the health-report
+# join over the quarantine arm's ledger. Host + tiny engine, seconds.
+# "0" opts out.
+ROBUST = os.environ.get("FEDCRACK_BENCH_ROBUST", "1") == "1"
 
 # Low-precision kernel A/B (round 20, detail.lowp_kernels): the quantized
 # predict program per kernel plane — reference (the r17 dequantize-then-
@@ -3306,6 +3398,16 @@ def _bench_federation_health() -> dict:
     return run_scaled_update_drill()
 
 
+def _bench_robust_aggregation() -> dict:
+    """detail.robust_aggregation (round 21): the 4-arm robust-combine A/B
+    over real gRPC — FedAvg drags and cliffs the canary; trimmed-mean,
+    Krum, and the ledger-coupled quarantine hold it — plus the
+    colluding-minority variant and the health-report exclusion join."""
+    from fedcrack_tpu.tools.chaos_drill import run_robust_aggregation_drill
+
+    return run_robust_aggregation_drill()
+
+
 def main() -> None:
     # Smoke-test hook: this image pre-imports jax at interpreter startup with
     # the axon (real TPU tunnel) platform, so a JAX_PLATFORMS=cpu env override
@@ -4003,6 +4105,30 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
                 skips,
                 "federation_health",
                 health_est,
+                "estimate exceeds remaining budget",
+            )
+
+    # ---- robust aggregation (round 21): the same SCALED_UPDATE poison as
+    # a 4-arm A/B — FedAvg drags the global ~x300 and cliffs the canary;
+    # trimmed-mean / Krum / the ledger-coupled quarantine hold IoU and cut
+    # the drag by >= 10x; the colluding-minority variant and the
+    # health-report join ride along ----
+    if ROBUST:
+        robust_est = 20.0  # nine tiny 1-round federations + one engine
+        if _fits(robust_est):
+            t0 = time.monotonic()
+            try:
+                detail["robust_aggregation"] = _bench_robust_aggregation()
+            except Exception as e:  # a host-only extra must never kill the artifact
+                detail["robust_aggregation"] = {"error": repr(e)}
+            section_s["robust_aggregation"] = time.monotonic() - t0
+            detail["budget"] = _budget_detail()
+            _set_payload(metric_headline, value, vs_baseline, detail)
+        else:
+            _skip(
+                skips,
+                "robust_aggregation",
+                robust_est,
                 "estimate exceeds remaining budget",
             )
 

@@ -12,6 +12,7 @@ from fedcrack_tpu.analysis.engine import Rule
 
 def all_rules() -> list[Rule]:
     from fedcrack_tpu.analysis.rules import (
+        agg_plane,
         async_plane,
         compress,
         deadcode,
@@ -29,7 +30,8 @@ def all_rules() -> list[Rule]:
     out: list[Rule] = []
     for pack in (
         determinism, durability, trace, transport, compress, async_plane,
-        obs_plane, health_plane, locks, deadcode, serve_plane, kernel_plane,
+        obs_plane, health_plane, agg_plane, locks, deadcode, serve_plane,
+        kernel_plane,
     ):
         out.extend(cls() for cls in pack.RULES)
     return out

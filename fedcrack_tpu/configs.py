@@ -344,6 +344,31 @@ class FedConfig:
     # instead of averaged. A single NaN client otherwise poisons the global
     # model for every client. Disable only for wire-format experiments.
     sanitize_updates: bool = True
+    # Byzantine-robust aggregation (round 21, fed/aggregation.py): how the
+    # server COMBINES the round's accepted updates. "fedavg" is the null
+    # algebra — the sample-weighted mean, bitwise-pinned to every plane's
+    # historical fold. "trimmed_mean" / "median" (alias "coordinate_median")
+    # are the coordinate-wise robust estimators of Yin et al. (ICML 2018);
+    # "krum" / "multi_krum" the distance-scored selection of Blanchard et
+    # al. (NeurIPS 2017). Robust combines ignore client-reported sample
+    # counts (a Byzantine client self-reports them) and run on the gRPC
+    # rounds plane and the buffered root only — edge tiers refuse them
+    # loudly (a trimmed partial of a partial is not a trimmed total).
+    aggregation: str = "fedavg"
+    # TrimmedMean's beta: drop floor(beta * n) per coordinate from each
+    # tail. [0, 0.5) so at least one value survives per coordinate.
+    trim_fraction: float = 0.1
+    # Krum/Multi-Krum's f: the assumed Byzantine count. Scores sum the
+    # n - f - 2 smallest squared distances (clamped to >= 1 neighbor);
+    # Multi-Krum averages the n - f lowest-scoring updates.
+    byzantine_f: int = 1
+    # Ledger-coupled quarantine (round 21): a client whose flush-time
+    # robust-z anomaly score (health/ledger.py observe_flush — the r18
+    # detection plane) is >= this threshold is EXCLUDED from the fold,
+    # logged in the history entry's "quarantined" map, and re-synced
+    # NOT_WAIT like a sanitation reject. 0 disables (detection without
+    # response — r18 behavior). Composable with any `aggregation`.
+    quarantine_z: float = 0.0
     # Mid-round durable server state (msgpack via atomic write+fsync+rename;
     # empty disables): persists cohort/phase/received blobs on every
     # membership or upload change, so a server killed MID-round resumes the
@@ -524,6 +549,28 @@ class FedConfig:
         if not 0.0 < self.quorum_fraction <= 1.0:
             raise ValueError(
                 f"quorum_fraction must be in (0, 1], got {self.quorum_fraction}"
+            )
+        if self.aggregation not in (
+            "fedavg", "trimmed_mean", "median", "coordinate_median",
+            "krum", "multi_krum",
+        ):
+            raise ValueError(
+                "aggregation must be one of 'fedavg', 'trimmed_mean', "
+                "'median', 'coordinate_median', 'krum', 'multi_krum', got "
+                f"{self.aggregation!r}"
+            )
+        if not 0.0 <= self.trim_fraction < 0.5:
+            raise ValueError(
+                f"trim_fraction must be in [0, 0.5), got {self.trim_fraction}"
+            )
+        if self.byzantine_f < 0:
+            raise ValueError(
+                f"byzantine_f must be >= 0, got {self.byzantine_f}"
+            )
+        if self.quarantine_z < 0.0:
+            raise ValueError(
+                f"quarantine_z must be >= 0 (0 disables), got "
+                f"{self.quarantine_z}"
             )
         if self.wire_dtype not in ("float32", "bfloat16"):
             raise ValueError(

@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import jax
 
+from fedcrack_tpu.fed import aggregation as _aggregation
 from fedcrack_tpu.fed import rounds as R
 from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
 from fedcrack_tpu.health import ledger as _health_ledger
@@ -104,25 +105,40 @@ def buffer_entry_from_wire(row) -> dict:
     }
 
 
-def fold_buffer(buffer, template) -> tuple:
-    """THE staleness-weighted sorted fold, shared by the root flush and
-    the edge tier's ``flush_partial`` (one fold, all tiers — the same
-    discipline as ``decode_and_validate_update``): entries sorted by
-    ``(cname, seq)``, decoded against ``template``, averaged with
-    effective weight ``ns * staleness_weight``. Returns ``(avg_tree,
-    entries_sorted, counts, eff, trees)`` — ``eff`` and ``trees`` aligned
-    with ``entries_sorted`` (the decoded trees, so the flush-time health
-    scoring reuses this decode instead of paying a second one); the
-    average is unweighted when every sample count is zero (mirroring the
-    sync barrier)."""
+def decode_buffer(buffer, template) -> tuple:
+    """The decode half of the buffered fold: entries sorted by ``(cname,
+    seq)``, decoded against ``template``. Returns ``(entries_sorted,
+    counts, eff, trees)`` aligned lists — split from the combine (round
+    21) so the root flush can ledger-score the decoded trees BEFORE
+    folding and quarantine flagged entries out of the triples."""
     if not buffer:
         raise RuntimeError("fold of an empty buffer")
     entries = sorted(buffer, key=_entry_sort_key)
     trees = [tree_from_bytes(e["blob"], template=template) for e in entries]
     counts = [e["ns"] for e in entries]
     eff = [e["ns"] * e["weight"] for e in entries]
-    weights = eff if any(c > 0 for c in counts) else None
-    return R.fedavg(trees, weights), entries, counts, eff, trees
+    return entries, counts, eff, trees
+
+
+def fold_buffer(buffer, template) -> tuple:
+    """THE staleness-weighted sorted fold, shared by the root flush and
+    the edge tier's ``flush_partial`` (one fold, all tiers — the same
+    discipline as ``decode_and_validate_update``): entries sorted by
+    ``(cname, seq)``, decoded against ``template``, combined through the
+    aggregation algebra's null instance (fed/aggregation.py) with
+    effective weight ``ns * staleness_weight``. Returns ``(avg_tree,
+    entries_sorted, counts, eff, trees)`` — ``eff`` and ``trees`` aligned
+    with ``entries_sorted`` (the decoded trees, so the flush-time health
+    scoring reuses this decode instead of paying a second one); the
+    average is unweighted when every effective weight is zero (mirroring
+    the sync barrier — ``eff[i] > 0`` iff ``ns[i] > 0``, the staleness
+    decay being strictly positive)."""
+    entries, counts, eff, trees = decode_buffer(buffer, template)
+    triples = [
+        (e["cname"], w, t) for e, w, t in zip(entries, eff, trees)
+    ]
+    avg = _aggregation.fold(_aggregation.FedAvg(), triples)
+    return avg, entries, counts, eff, trees
 
 
 # Decoded-base memo for the accept path: version -> (blob, tree). Every
@@ -283,6 +299,18 @@ class BufferedAggregator:
             # holds the new version (recorded, so its next framed delta is
             # pinned to what it actually adopted).
             state = BufferedAggregator.record_pull(state, cname)
+            if cname in state.history[-1]["quarantined"]:
+                # The flush-triggering client was quarantined out of its
+                # own flush: NOT_WAIT (the sanitation-reject treatment) so
+                # the direct reply fires the client-side codec rollback —
+                # a topk sender's error-feedback residual re-enters
+                # instead of being dropped as "sent". Mirrors the sync
+                # barrier's quarantined-trigger path.
+                return state, R.Reply(
+                    status=R.NOT_WAIT,
+                    blob=state.broadcast_blob,
+                    config=R._ready_config(state, R.NOT_WAIT),
+                )
             status = R.FIN if state.phase == R.PHASE_FINISHED else R.RESP_ARY
             return state, R.Reply(
                 status=status,
@@ -341,13 +369,43 @@ class BufferedAggregator:
         """
         import numpy as np
 
-        avg, entries, counts, eff, trees = fold_buffer(
+        entries, counts, eff, trees = decode_buffer(
             state.buffer, state.template
         )
+        # Health ledger (round 18): score this flush's geometry on the
+        # already-decoded trees, in the fold's own sorted order. The base
+        # is the CURRENT global for every entry — a uniform reference
+        # despite per-entry pull bases; norms at the gate kept the
+        # per-base geometry, this window scores cohort coherence. Round
+        # 21 moved the scoring BEFORE the fold so the scores can GATE it
+        # (quarantine_z), mirroring rounds._aggregate.
+        new_ledger, scores = _health_ledger.observe_flush(
+            state.ledger,
+            [(e["cname"], t) for e, t in zip(entries, trees)],
+            tree_from_bytes(state.global_blob, template=state.template),
+        )
+        quarantined = _aggregation.quarantine_set(
+            scores, [e["cname"] for e in entries], state.config.quarantine_z
+        )
+        for qname in sorted(quarantined):
+            new_ledger = _health_ledger.record_quarantine(new_ledger, qname)
+        keep = [
+            i for i, e in enumerate(entries)
+            if e["cname"] not in quarantined
+        ]
+        avg = _aggregation.fold(
+            _aggregation.from_config(state.config),
+            [(entries[i]["cname"], eff[i], trees[i]) for i in keep],
+        )
+        # The FedAsync mix anchor is computed over the KEPT entries only —
+        # a quarantined update must pull the global toward nothing, not
+        # even through the mix ratio.
+        kept_counts = [counts[i] for i in keep]
+        kept_eff = [eff[i] for i in keep]
         mix = 1.0
-        total_ns = float(sum(counts))
-        if any(c > 0 for c in counts):
-            mix = float(sum(eff)) / total_ns
+        total_ns = float(sum(kept_counts))
+        if any(c > 0 for c in kept_counts):
+            mix = float(sum(kept_eff)) / total_ns
         if mix < 1.0:
             current = tree_from_bytes(state.global_blob, template=state.template)
             keep, take = np.float32(1.0 - mix), np.float32(mix)
@@ -390,6 +448,10 @@ class BufferedAggregator:
             "bytes_broadcast": len(new_wire_blob or new_blob),
             "cohort_size": len(state.cohort),
             "rejected": dict(state.rejected),
+            # Round 21: cname -> the robust-z score that excluded it from
+            # the fold (empty = everyone folded). The per-entry lists
+            # above keep their historical meaning (what the BUFFER held).
+            "quarantined": quarantined,
         }
         # Retained-base window: the new broadcast joins, versions older
         # than max_staleness leave — the delta-decode memory bound.
@@ -399,16 +461,6 @@ class BufferedAggregator:
             if new_version - v <= state.config.max_staleness
         }
         bases[new_version] = new_wire_blob or new_blob
-        # Health ledger (round 18): score this flush's geometry on the
-        # trees the fold already decoded, in the fold's own sorted order.
-        # The base is the CURRENT global for every entry — a uniform
-        # reference despite per-entry pull bases; norms at the gate kept
-        # the per-base geometry, this window scores cohort coherence.
-        new_ledger, _scores = _health_ledger.observe_flush(
-            state.ledger,
-            [(e["cname"], t) for e, t in zip(entries, trees)],
-            tree_from_bytes(state.global_blob, template=state.template),
-        )
         return state._replace(
             ledger=new_ledger,
             global_blob=new_blob,

@@ -79,9 +79,9 @@ import jax
 
 from fedcrack_tpu.compress import frames as wire_frames
 from fedcrack_tpu.configs import FedConfig
+from fedcrack_tpu.fed import aggregation as _aggregation
 from fedcrack_tpu.fed.algorithms import (
     apply_server_opt,
-    fedavg,
     make_server_optimizer,
 )
 from fedcrack_tpu.fed.serialization import (
@@ -540,8 +540,10 @@ def apply_fedopt(state: ServerState, avg: Any) -> tuple[Any, Any]:
 
 
 def _aggregate(state: ServerState, now: float) -> ServerState:
-    """FedAvg (optionally + FedOpt server step) over the round's received
-    updates; advance round/version."""
+    """Fold the round's received updates through the configured aggregation
+    algebra (round 21, fed/aggregation.py; the FedAvg null instance is
+    bitwise-pinned to the historical sorted fold), optionally + the FedOpt
+    server step; advance round/version."""
     names = sorted(state.received.keys())
     # Decode against the float32 template so server math keeps full
     # precision even when the wire carries bfloat16 payloads.
@@ -550,8 +552,28 @@ def _aggregate(state: ServerState, now: float) -> ServerState:
         for n in names
     ]
     counts = [state.received[n][1] for n in names]
-    weights = counts if any(c > 0 for c in counts) else None
-    avg = fedavg(trees, weights)
+    # Health ledger (round 18): score this flush's update geometry — norm
+    # and cosine-to-cohort-mean per client, robust z vs the window — on the
+    # SAME decoded trees the fold is about to combine (no second decode).
+    # Round 21 moved the scoring BEFORE the fold so the scores can GATE it:
+    # with quarantine_z > 0 a flagged client is excluded from the triples
+    # entirely (detection → response).
+    new_ledger, scores = _health_ledger.observe_flush(
+        state.ledger,
+        list(zip(names, trees)),
+        _decoded_round_base(state),
+    )
+    quarantined = _aggregation.quarantine_set(
+        scores, names, state.config.quarantine_z
+    )
+    for qname in quarantined:
+        new_ledger = _health_ledger.record_quarantine(new_ledger, qname)
+    triples = [
+        (n, c, t)
+        for n, c, t in zip(names, counts, trees)
+        if n not in quarantined
+    ]
+    avg = _aggregation.fold(_aggregation.from_config(state.config), triples)
     avg, opt_state = apply_fedopt(state, avg)
     new_blob = tree_to_bytes(avg)
     cast = _wire_cast(state.config)
@@ -584,15 +606,12 @@ def _aggregate(state: ServerState, now: float) -> ServerState:
         "quorum": _quorum_target(state),
         "cohort_size": len(state.cohort),
         "rejected": dict(state.rejected),
+        # Quarantine observability (round 21): name -> the robust-z score
+        # that excluded it from the fold. Empty means everyone folded —
+        # `clients`/`samples` keep their historical meaning (who REPORTED
+        # this round), so exclusion is read from this map, not from them.
+        "quarantined": quarantined,
     }
-    # Health ledger (round 18): score this flush's update geometry — norm
-    # and cosine-to-cohort-mean per client, robust z vs the window — on the
-    # SAME decoded trees FedAvg just averaged (no second decode).
-    new_ledger, _scores = _health_ledger.observe_flush(
-        state.ledger,
-        list(zip(names, trees)),
-        _decoded_round_base(state),
-    )
     return state._replace(
         ledger=new_ledger,
         global_blob=new_blob,
@@ -870,6 +889,20 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
             )
             if _barrier_met(state):
                 state = _aggregate(state, now)
+                if cname in state.history[-1]["quarantined"]:
+                    # The barrier-closing client was itself quarantined out
+                    # of the fold it triggered: re-sync it NOT_WAIT (the
+                    # sanitation-reject treatment) instead of handing it a
+                    # RESP_ARY that claims its update was averaged. The
+                    # direct NOT_WAIT reply is what fires the client-side
+                    # codec rollback (transport/client.py rollback_last),
+                    # so a topk sender's error-feedback residual re-enters
+                    # instead of being dropped as "sent".
+                    return state, Reply(
+                        status=NOT_WAIT,
+                        blob=state.broadcast_blob,
+                        config=_ready_config(state, NOT_WAIT),
+                    )
                 status = FIN if state.phase == PHASE_FINISHED else RESP_ARY
                 return state, Reply(
                     status=status,

@@ -43,7 +43,8 @@ import numpy as np
 from fedcrack_tpu.configs import FedConfig
 from fedcrack_tpu.fed import buffered as _buffered
 from fedcrack_tpu.fed import rounds as R
-from fedcrack_tpu.fed.algorithms import fedavg, sample_cohort
+from fedcrack_tpu.fed import aggregation as _aggregation
+from fedcrack_tpu.fed.algorithms import sample_cohort
 from fedcrack_tpu.fed.rounds import decode_and_validate_update, quorum_target
 from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
 from fedcrack_tpu.health import ledger as _health_ledger
@@ -121,6 +122,7 @@ class EdgeAggregator:
         buffer_k: int = 2,
         staleness_alpha: float = 0.5,
         max_staleness: int = 4,
+        aggregation: str = "fedavg",
     ):
         if not 0.0 < quorum_fraction <= 1.0:
             raise ValueError(
@@ -128,6 +130,24 @@ class EdgeAggregator:
             )
         if update_codec not in ("null", "int8", "topk_delta"):
             raise ValueError(f"unknown update_codec {update_codec!r}")
+        if aggregation != "fedavg":
+            # Robust combines do NOT commute with hierarchical averaging:
+            # a trimmed mean of per-edge trimmed partials is not the
+            # trimmed mean of the cohort (each edge trims against its own
+            # shard's statistics, and the root then re-averages already-
+            # censored partials — the Byzantine update an edge fails to
+            # trim rides up at full weight, while the root has lost the
+            # per-leaf geometry it would need to catch it). Until a
+            # composition-safe scheme lands, robust aggregation runs where
+            # the full cohort is visible (the gRPC rounds plane and the
+            # buffered root); the edge tier refuses loudly rather than
+            # silently computing a different federation.
+            raise ValueError(
+                f"edge tier only supports aggregation='fedavg', got "
+                f"{aggregation!r}: a trimmed/robust partial of a partial "
+                "is not a robust total — run robust combines at the root "
+                "(FedConfig.aggregation)"
+            )
         if mode not in ("sync", "buffered"):
             raise ValueError(f"mode must be 'sync' or 'buffered', got {mode!r}")
         if buffer_k < 1:
@@ -543,11 +563,15 @@ class EdgeAggregator:
             for n in names
         ]
         counts = [self.received[n][1] for n in names]
-        weights = counts if any(c > 0 for c in counts) else None
         self.ledger, _scores = _health_ledger.observe_flush(
             self.ledger, list(zip(names, trees)), self._decoded_base()
         )
-        avg = fedavg(trees, weights)
+        # The null algebra instance (round 21): bitwise the historical
+        # sorted sample-weighted fold. The edge NEVER folds robustly (ctor
+        # refusal — see __init__).
+        avg = _aggregation.fold(
+            _aggregation.FedAvg(), list(zip(names, counts, trees))
+        )
         total = int(sum(counts))
         blob = tree_to_bytes(avg)
         if self.update_codec != "null":

@@ -70,6 +70,12 @@ def new_record() -> dict:
         "cosines": [],             # last LEDGER_WINDOW cosines-to-cohort-mean
         "anomaly": 0.0,            # robust z at the most recent flush
         "flags": 0,                # flushes where anomaly >= ANOMALY_ALERT
+        # Round 21: flushes this client was EXCLUDED from by the ledger-
+        # coupled quarantine (FedConfig.quarantine_z). Outside the
+        # `conservation` identity by design: a quarantined update passed
+        # the acceptance gate (its offer is already counted "accepted");
+        # quarantine is a flush-time fold decision, not a gate verdict.
+        "quarantined": 0,
     }
 
 
@@ -130,6 +136,18 @@ def record_offer(
         rejected = dict(rec["rejected"])
         rejected[key] = rejected.get(key, 0) + 1
         rec["rejected"] = rejected
+    out[cname] = rec
+    return out
+
+
+def record_quarantine(ledger: Mapping[str, dict], cname: str) -> dict:
+    """Fold one flush-time quarantine decision into the ledger (copy-on-
+    write): the named client's accepted-but-excluded counter. Called by the
+    round machines right after :func:`observe_flush` hands them the scores
+    that crossed ``FedConfig.quarantine_z``."""
+    out = dict(ledger)
+    rec = dict(out.get(cname) or new_record())
+    rec["quarantined"] = int(rec.get("quarantined", 0)) + 1
     out[cname] = rec
     return out
 
@@ -229,6 +247,8 @@ def ledger_to_wire(ledger: Mapping[str, dict]) -> list:
             [[k, int(rec["rejected"][k])] for k in sorted(rec["rejected"])],
             [float(x) for x in rec["norms"]],
             [float(x) for x in rec["cosines"]],
+            # Field 14 (round 21); readers accept 13-field r18 rows.
+            int(rec.get("quarantined", 0)),
         ])
     return rows
 
@@ -242,7 +262,11 @@ def ledger_from_wire(rows: Iterable) -> dict:
             rec["samples"], rec["wire_bytes"], rec["last_round"],
             rec["last_staleness"], rec["anomaly"], rec["flags"],
             rejected, norms, cosines,
-        ) = row
+        ) = row[:13]
+        # r18 statefiles carry 13-field rows; round 21 appended the
+        # quarantined counter (missing = 0 via new_record).
+        if len(row) > 13:
+            rec["quarantined"] = int(row[13])
         rec["rejected"] = {str(k): int(v) for k, v in rejected}
         rec["norms"] = [float(x) for x in norms]
         rec["cosines"] = [float(x) for x in cosines]

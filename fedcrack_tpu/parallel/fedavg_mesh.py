@@ -50,67 +50,16 @@ from fedcrack_tpu.train.local import make_optimizer
 
 CLIENTS, BATCH = "clients", "batch"
 
-
-def _ordered_cohort_sums(tree: Any, weight: jax.Array, init: tuple) -> tuple:
-    """Deterministically-ORDERED masked weighted sums over the ``clients``
-    axis, continuing the partial-sum carry ``init = (num_tree_f32,
-    den_scalar_f32)``: each leaf is all_gathered and left-folded into the
-    carry one client at a time, in client-index order.
-
-    Why not ``lax.psum``: an all-reduce's float addition order is
-    backend/topology-defined (CPU XLA reduces rank-sequentially, a TPU ring
-    reduces in ring order), so group-partial psums do NOT compose bitwise —
-    ``psum_4(x) != psum_2(x[:2]) + psum_2(x[2:])`` (measured). The fold
-    pins ONE expression tree — ``(((0 + w0*x0) + w1*x1) + ...)`` — that is
-    identical whether the cohort runs as one C-wide mesh or as sequential
-    groups of G continuing the carry (round 13's time-multiplexed cohort
-    contract, test-pinned bitwise for groups in {1, 2, 4}). Zero-weight
-    padding clients contribute ``±0.0``, which is a bitwise no-op on any
-    partial sum reachable from the ``+0.0`` init, so ragged cohorts pad
-    clean. Cost vs psum: an all_gather (G x leaf bytes on the ICI) plus a
-    serial length-G fold — noise next to the round's epochs x steps scan.
-    """
-    num, den = init
-    gathered = jax.tree_util.tree_map(
-        lambda x: lax.all_gather(weight * x.astype(jnp.float32), CLIENTS), tree
-    )
-    gw = lax.all_gather(weight, CLIENTS)
-
-    def body(i, acc):
-        acc_num, acc_den = acc
-        acc_num = jax.tree_util.tree_map(
-            lambda a, g: a + g[i], acc_num, gathered
-        )
-        return acc_num, acc_den + gw[i]
-
-    return lax.fori_loop(0, gw.shape[0], body, (num, den))
-
-
-def _zero_sums_like(tree: Any) -> tuple:
-    """The fold's identity carry: f32 zeros per update leaf + a 0 weight."""
-    return (
-        jax.tree_util.tree_map(
-            lambda x: jnp.zeros(jnp.shape(x), jnp.float32), tree
-        ),
-        jnp.zeros((), jnp.float32),
-    )
-
-
-def _finish_cohort_mean(num: Any, total_w: jax.Array, fallback: Any) -> Any:
-    """Divide the ordered sums into the FedAvg mean, with the empty-cohort
-    guard: zero total weight returns ``fallback`` (the round's incoming
-    global model) unchanged. Elementwise ops only — bitwise deterministic
-    regardless of which program (in-round tail, grouped finalize) runs it."""
-    denom = jnp.maximum(total_w, 1e-9)
-    averaged = jax.tree_util.tree_map(
-        lambda s, orig: (s / denom).astype(orig.dtype), num, fallback
-    )
-    keep = total_w > 0.0
-    return jax.tree_util.tree_map(
-        lambda avg, orig: jnp.where(keep, avg, orig.astype(avg.dtype)),
-        averaged,
-        fallback,
-    )
+# The ordered cohort fold moved to fed/aggregation.py (round 21) — the one
+# module owning "how updates combine" owns the mesh instance too. Aliased
+# under the historical names so every traced program here is the identical
+# expression tree (the r13 groups_bitwise_equal contract is unchanged);
+# ``axis_name`` defaults to "clients" == CLIENTS.
+from fedcrack_tpu.fed.aggregation import (  # noqa: E402
+    mesh_finish_cohort_mean as _finish_cohort_mean,
+    mesh_ordered_fold as _ordered_cohort_sums,
+    mesh_zero_sums as _zero_sums_like,
+)
 
 
 def _host_view(x) -> np.ndarray | None:

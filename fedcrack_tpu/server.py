@@ -87,6 +87,35 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         "counters the foreground imbalance; 1 = reference's plain BCE)",
     )
     p.add_argument(
+        "--aggregation",
+        dest="aggregation",
+        help="how accepted updates combine (fed/aggregation.py): fedavg "
+        "(sample-weighted mean, the default), trimmed_mean, median/"
+        "coordinate_median, krum, multi_krum — the robust combines ignore "
+        "client-reported sample counts",
+    )
+    p.add_argument(
+        "--trim-fraction",
+        type=float,
+        dest="trim_fraction",
+        help="trimmed_mean's beta: drop floor(beta*n) per coordinate from "
+        "each tail; [0, 0.5)",
+    )
+    p.add_argument(
+        "--byzantine-f",
+        type=int,
+        dest="byzantine_f",
+        help="krum/multi_krum's assumed Byzantine count f",
+    )
+    p.add_argument(
+        "--quarantine-z",
+        type=float,
+        dest="quarantine_z",
+        help="exclude a client from the fold when its flush-time robust-z "
+        "anomaly score reaches this threshold (0 disables; 3.5 matches "
+        "the ledger's alert line)",
+    )
+    p.add_argument(
         "--server-optimizer",
         dest="server_optimizer",
         help="FedOpt server update: avg (plain FedAvg), momentum/fedavgm, "
@@ -238,6 +267,10 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         ("max_staleness", "max_staleness"),
         ("fedprox_mu", "fedprox_mu"),
         ("pos_weight", "pos_weight"),
+        ("aggregation", "aggregation"),
+        ("trim_fraction", "trim_fraction"),
+        ("byzantine_f", "byzantine_f"),
+        ("quarantine_z", "quarantine_z"),
         ("server_optimizer", "server_optimizer"),
         ("server_lr", "server_lr"),
         ("server_momentum", "server_momentum"),

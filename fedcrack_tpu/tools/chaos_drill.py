@@ -1130,6 +1130,293 @@ def run_scaled_update_drill() -> dict:
     }
 
 
+def run_robust_aggregation_drill() -> dict:
+    """Robust-aggregation A/B drill (round 21): the r18 SCALED_UPDATE
+    scenario re-run as FOUR arms over real gRPC — identical cohort
+    (a=1.0, b=1.2, c's 1.1 update amplified x``SCALE_FACTOR`` through a
+    consumed chaos FaultPlan), identical wire path, the ONLY delta being
+    ``FedConfig.aggregation``/``quarantine_z``:
+
+    - ``fedavg``       — the r18 baseline; the global drags by ~x300.
+    - ``trimmed_mean`` — beta=0.34 trims one value per coordinate end;
+      the x1000 coordinate is the trimmed tail, drag collapses to the
+      honest spread.
+    - ``krum``         — f=1; the poisoned vector's pairwise distance is
+      astronomical, an HONEST update is selected verbatim.
+    - ``fedavg_quarantine`` — null combine, ``quarantine_z=3.5``: the
+      flush-time robust z-score (the r18 *detection*) now feeds the fold's
+      exclusion gate (the r21 *response*). The poisoned client lands LAST
+      so it triggers the flush — and gets the direct ``NOT_WAIT`` resync
+      reply (the EF-rollback contract) instead of an ``RESP_ARY`` that
+      would claim its update was averaged.
+
+    Each arm's serve-side story rides one shared tiny-ResUNet engine: the
+    canary reference is evaluated once on the boot weights, then every
+    arm installs the boot weights scaled by THAT arm's combine applied to
+    an honest/honest/x``SCALE_FACTOR`` cohort (the exact part-2 framing
+    of the r18 drill). FedAvg cliffs the IoU; every robust arm holds it.
+
+    A colluding-minority variant re-runs the fed plane with 7 clients —
+    5 honest, 2 colluders shipping the IDENTICAL amplified update (the
+    worst case for Krum's min-distance score; n=7 >= 2f+3 keeps the
+    selection sound) — across fedavg / trimmed_mean / krum / multi_krum /
+    quarantine, and the quarantine arm's ledger round-trips through
+    ``tools/health_report`` to prove the exclusion is visible there too.
+    """
+    import jax
+
+    from fedcrack_tpu.chaos import inject
+    from fedcrack_tpu.chaos.inject import _poison_weights
+    from fedcrack_tpu.chaos.plan import SCALED_UPDATE, Fault, FaultPlan
+    from fedcrack_tpu.configs import ModelConfig, ServeConfig
+    from fedcrack_tpu.fed import aggregation as A
+    from fedcrack_tpu.health import ledger as health_ledger
+    from fedcrack_tpu.health.canary import CanaryEvaluator
+    from fedcrack_tpu.models.resunet import init_variables
+    from fedcrack_tpu.obs.registry import MetricsRegistry
+    from fedcrack_tpu.serve.engine import InferenceEngine
+    from fedcrack_tpu.serve.hot_swap import ModelVersionManager
+    from fedcrack_tpu.tools.health_report import build_report, validate_report
+    from fedcrack_tpu.transport import transport_pb2 as pb
+    from fedcrack_tpu.transport.service import FedServer, ServerThread
+
+    t0 = time.perf_counter()
+
+    def run_arm(clients, poisoned_names, poison_value, order, **agg_kwargs):
+        """One real-gRPC round: ``clients`` is {name: (value, ns)};
+        updates land in ``order`` (last one closes the barrier); every
+        name in ``poisoned_names`` ships its update through
+        ``_poison_weights(..., SCALED_UPDATE)``, scheduled and consumed
+        via a FaultPlan so the artifact proves the faults fired."""
+        plan = FaultPlan(
+            [Fault(kind=SCALED_UPDATE, round=1, client=n)
+             for n in sorted(poisoned_names)]
+        )
+        cfg = FedConfig(
+            max_rounds=1,
+            cohort_size=len(clients),
+            registration_window_s=5.0,
+            round_deadline_s=60.0,
+            port=0,
+            **agg_kwargs,
+        )
+        server = FedServer(cfg, _vars(0.0), tick_period_s=0.02)
+        replies = {}
+        with ServerThread(server) as st:
+            channel, call = _raw_caller(st.port)
+            for c in order:
+                assert call(_ready(c)).status == R.SW
+            for c in order:
+                value, ns = clients[c]
+                if c in poisoned_names:
+                    fault = plan.take(SCALED_UPDATE, client=c, round=1)
+                    assert fault is not None
+                    msg = pb.ClientMessage(cname=c)
+                    msg.done.round = 1
+                    msg.done.weights = _poison_weights(
+                        tree_to_bytes(_vars(value)), SCALED_UPDATE
+                    )
+                    msg.done.sample_count = ns
+                else:
+                    msg = _done(c, 1, value, ns)
+                replies[c] = call(msg)
+            channel.close()
+            state = st.state
+        closer = replies[order[-1]]
+        # The round-closing reply carries the aggregated global UNLESS the
+        # closer was quarantined (NOT_WAIT resync); read the broadcast then.
+        blob = closer.weights if closer.weights else state.broadcast_blob
+        got_avg = float(np.mean(tree_from_bytes(blob)["params"]["w"]))
+        entry = state.history[0] if state.history else {}
+        return {
+            "state": state,
+            "entry": entry,
+            "replies": replies,
+            "global_avg": got_avg,
+        }
+
+    # ---- part 1: the 4-arm A/B (3 clients, 1 poisoned) ----
+    clients3 = {"a": (1.0, 10), "b": (1.2, 10), "c": (1.1, 10)}
+    honest_mean = (1.0 * 10 + 1.2 * 10) / 20.0  # what a,b alone average to
+    arm_specs = {
+        # r18 ordering (poisoned first) for the combine arms; the
+        # quarantine arm puts the poisoned client LAST so the NOT_WAIT
+        # direct-reply resync contract is exercised on the wire.
+        "fedavg": dict(order=("c", "a", "b")),
+        "trimmed_mean": dict(
+            order=("c", "a", "b"), aggregation="trimmed_mean",
+            trim_fraction=0.34,
+        ),
+        "krum": dict(
+            order=("c", "a", "b"), aggregation="krum", byzantine_f=1,
+        ),
+        "fedavg_quarantine": dict(
+            order=("a", "b", "c"), quarantine_z=3.5,
+        ),
+    }
+    arms = {}
+    raw = {}
+    for name, spec in arm_specs.items():
+        spec = dict(spec)
+        order = spec.pop("order")
+        r = run_arm(clients3, {"c"}, 1.1, order, **spec)
+        raw[name] = r
+        drag = abs(r["global_avg"] - honest_mean)
+        arms[name] = {
+            "aggregation": spec.get("aggregation", "fedavg"),
+            "quarantine_z": spec.get("quarantine_z", 0.0),
+            "global_avg": round(r["global_avg"], 4),
+            "drag": round(drag, 4),
+            "quarantined": {
+                k: round(v, 3)
+                for k, v in r["entry"].get("quarantined", {}).items()
+            },
+        }
+    fedavg_drag = abs(raw["fedavg"]["global_avg"] - honest_mean)
+    for name in ("trimmed_mean", "krum", "fedavg_quarantine"):
+        d = abs(raw[name]["global_avg"] - honest_mean)
+        arms[name]["drag_reduction_vs_fedavg"] = round(
+            fedavg_drag / max(d, 1e-9), 1
+        )
+    q = raw["fedavg_quarantine"]
+    arms["fedavg_quarantine"].update({
+        # The poisoned closer is excluded AND resynced: NOT_WAIT with the
+        # clean global attached (fires the client-side topk EF rollback).
+        "poisoned_reply": q["replies"]["c"].status,
+        "poisoned_resynced_not_wait": q["replies"]["c"].status == R.NOT_WAIT,
+        "clean_global_attached": bool(q["replies"]["c"].weights),
+        "ledger_quarantined_count": q["state"].ledger.get("c", {}).get(
+            "quarantined", 0
+        ),
+        "honest_not_quarantined": all(
+            q["state"].ledger.get(n, {}).get("quarantined", 0) == 0
+            for n in ("a", "b")
+        ),
+    })
+
+    # ---- part 2: per-arm canary over ONE shared tiny serve stack ----
+    # The serving-side view of each arm: the boot weights scaled by the
+    # arm's combine applied to an honest/honest/xSCALE cohort — the exact
+    # r18 part-2 framing ((1 + 1 + SCALE)/3 for FedAvg), now computed
+    # THROUGH the real algebra per arm instead of hard-coded for FedAvg.
+    def arm_factor(algebra):
+        triples = [
+            ("a", 10, {"w": np.float32([1.0])}),
+            ("b", 10, {"w": np.float32([1.0])}),
+            ("c", 10, {"w": np.float32([1.0 * inject.SCALE_FACTOR])}),
+        ]
+        return float(A.fold(algebra, triples)["w"][0])
+
+    factors = {
+        "fedavg": arm_factor(A.FedAvg()),
+        "trimmed_mean": arm_factor(A.TrimmedMean(0.34)),
+        "krum": arm_factor(A.Krum(1)),
+        # Quarantine excludes c before the fold (part 1 proved that over
+        # the wire); the serving factor is the honest mean: 1.0 exactly.
+        "fedavg_quarantine": 1.0,
+    }
+    model_config = ModelConfig(
+        img_size=16, stem_features=4, encoder_features=(8,),
+        decoder_features=(8, 4),
+    )
+    serve_config = ServeConfig(
+        bucket_sizes=(16,), max_batch=4, max_delay_ms=30.0, tile_overlap=4
+    )
+    v0 = init_variables(jax.random.key(0), model_config)
+    reg = MetricsRegistry()
+    engine = InferenceEngine(model_config, serve_config)
+    canary = CanaryEvaluator(engine, registry=reg)
+    manager = ModelVersionManager(engine, v0, initial_version=0, canary=canary)
+    engine.warmup(manager.snapshot()[1])
+    ref = canary.evaluate(0, manager.snapshot()[1])
+    for version, name in enumerate(arms, start=1):
+        factor = factors[name]
+        v_arm = jax.tree_util.tree_map(
+            lambda a: a * np.asarray(factor, np.asarray(a).dtype)
+            if np.asarray(a).dtype.kind == "f"
+            else a,
+            v0,
+        )
+        installed = manager.install(version, v_arm)
+        assert installed and canary.last is not None
+        arms[name]["canary_iou"] = round(float(canary.last["iou"]), 6)
+        arms[name]["serve_factor"] = round(factor, 4)
+
+    # ---- part 3: colluding minority (7 clients, 2 identical colluders) ----
+    honest7 = {
+        "h1": (1.0, 10), "h2": (1.05, 10), "h3": (1.1, 10),
+        "h4": (1.15, 10), "h5": (1.2, 10),
+    }
+    clients7 = dict(honest7, p1=(1.1, 10), p2=(1.1, 10))
+    order7 = ("p1", "p2", "h1", "h2", "h3", "h4", "h5")
+    honest_mean7 = sum(v for v, _ in honest7.values()) / len(honest7)
+    colluding_specs = {
+        "fedavg": {},
+        # floor(0.3 * 7) = 2 trimmed per coordinate end: both colluders.
+        "trimmed_mean": dict(aggregation="trimmed_mean", trim_fraction=0.3),
+        "krum": dict(aggregation="krum", byzantine_f=2),
+        "multi_krum": dict(aggregation="multi_krum", byzantine_f=2),
+        "fedavg_quarantine": dict(quarantine_z=3.5),
+    }
+    colluding = {}
+    q7_state = None
+    for name, spec in colluding_specs.items():
+        r = run_arm(clients7, {"p1", "p2"}, 1.1, order7, **spec)
+        d = abs(r["global_avg"] - honest_mean7)
+        colluding[name] = {
+            "global_avg": round(r["global_avg"], 4),
+            "drag": round(d, 4),
+            "quarantined": sorted(r["entry"].get("quarantined", {})),
+        }
+        if name == "fedavg_quarantine":
+            q7_state = r["state"]
+    fedavg_drag7 = colluding["fedavg"]["drag"]
+    colluders_beaten = {
+        name: bool(colluding[name]["drag"] <= 0.25)
+        for name in colluding if name != "fedavg"
+    }
+
+    # ---- part 4: the exclusion is visible in the joined health report ----
+    with tempfile.TemporaryDirectory() as tmp:
+        ledger_path = os.path.join(tmp, "ledger.jsonl")
+        health_ledger.write_ledger_jsonl(q7_state.ledger, ledger_path)
+        report = build_report(ledger_path)
+        violations = validate_report(report)
+    health_part = {
+        "schema_violations": violations,
+        "quarantines": report["summary"]["quarantines"],
+        "quarantined_clients": report["summary"]["quarantined_clients"],
+        "exclusion_visible": report["summary"]["quarantined_clients"]
+        == ["p1", "p2"],
+    }
+
+    robust_arm_names = ("trimmed_mean", "krum", "fedavg_quarantine")
+    return {
+        "scale_factor": inject.SCALE_FACTOR,
+        "honest_mean": honest_mean,
+        "reference_iou": round(float(ref["iou"]), 6),
+        "arms": arms,
+        "fedavg_cliffed": arms["fedavg"]["canary_iou"] < 0.5,
+        "robust_arms_hold": all(
+            arms[n]["canary_iou"] >= 0.9 for n in robust_arm_names
+        ),
+        "drag_reduced_10x": all(
+            arms[n]["drag_reduction_vs_fedavg"] >= 10.0
+            for n in robust_arm_names
+        ),
+        "colluding": {
+            "n_clients": len(clients7),
+            "colluders": ["p1", "p2"],
+            "honest_mean": honest_mean7,
+            "fedavg_drag": fedavg_drag7,
+            "arms": colluding,
+            "colluders_beaten": colluders_beaten,
+        },
+        "health_report": health_part,
+        "drill_s": round(time.perf_counter() - t0, 4),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--out", required=True)
@@ -1153,6 +1440,7 @@ def main(argv=None) -> int:
             "buffered_kill": run_buffered_kill_drill(),
             "replica_crash": run_replica_crash_drill(),
             "scaled_update": run_scaled_update_drill(),
+            "robust_aggregation": run_robust_aggregation_drill(),
             "stream_reset": run_stream_reset_drill(),
         }
     except BaseException:

@@ -48,6 +48,9 @@ LEDGER_ROW_SCHEMA = {
     "cosines": list,
     "anomaly": (int, float),
     "flags": int,
+    # Round 21: flushes this client was excluded from by the ledger-coupled
+    # quarantine (detect -> exclude, end to end in one report).
+    "quarantined": int,
 }
 CANARY_EVAL_SCHEMA = {
     "version": int,
@@ -66,6 +69,8 @@ SUMMARY_SCHEMA = {
     "flagged_clients": list,
     "max_anomaly": (int, float),
     "conservation_violations": list,
+    "quarantines": int,
+    "quarantined_clients": list,
 }
 
 
@@ -100,6 +105,16 @@ def build_report(
             default=0.0,
         ),
         "conservation_violations": cons["violations"],
+        # Round 21: the response layer's totals — how many flush-time
+        # exclusions the quarantine gate made, and for whom; joined with
+        # the per-client `flagged` detection bit above, the report shows
+        # detect -> exclude end to end.
+        "quarantines": sum(
+            int(r.get("quarantined", 0)) for r in ledger.values()
+        ),
+        "quarantined_clients": sorted(
+            n for n, r in ledger.items() if int(r.get("quarantined", 0)) > 0
+        ),
     }
     canary = None
     if canary_path:

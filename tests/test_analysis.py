@@ -423,6 +423,57 @@ def test_health001_client_label_outside_chokepoint():
     assert engine.lint_modules(modules) == []
 
 
+# ---- aggregation-algebra pack ----
+
+
+def test_agg001_fedavg_call_outside_the_algebra():
+    """AGG001 (round 21): a direct ``fedavg(...)`` call in fed/ or
+    parallel/ is a fifth copy of the aggregation fold — invisible to
+    ``FedConfig.aggregation``, the quarantine gate, and every robust
+    combine. Only the two chokepoints may spell the primitive."""
+    bad = (
+        "from fedcrack_tpu.fed.algorithms import fedavg\n"
+        "avg = fedavg(trees, weights)\n"
+    )
+    # Default fixture path is fedcrack_tpu/fed/fixture.py: in scope.
+    assert "AGG001" in rule_ids(lint(bad))
+    # Attribute receivers (the aliasing idioms the planes actually used).
+    assert "AGG001" in rule_ids(
+        lint("from fedcrack_tpu.fed import rounds as R\n"
+             "avg = R.fedavg(trees, w)\n")
+    )
+    # The mesh plane is in scope too.
+    assert "AGG001" in rule_ids(
+        lint(bad, path="fedcrack_tpu/parallel/fixture.py")
+    )
+    # The chokepoints themselves are exempt: the algebra's instances and
+    # the primitive's home.
+    assert "AGG001" not in rule_ids(
+        lint(bad, path="fedcrack_tpu/fed/aggregation.py")
+    )
+    assert "AGG001" not in rule_ids(
+        lint(bad, path="fedcrack_tpu/fed/algorithms.py")
+    )
+    # Outside fed//parallel/ (benches, tools, tests cross-checking the
+    # algebra against the primitive) is deliberately out of scope.
+    assert "AGG001" not in rule_ids(
+        lint(bad, path="fedcrack_tpu/tools/fixture.py")
+    )
+    # The sanctioned route draws no finding.
+    good = (
+        "from fedcrack_tpu.fed import aggregation as _aggregation\n"
+        "avg = _aggregation.fold(_aggregation.FedAvg(), triples)\n"
+    )
+    assert "AGG001" not in rule_ids(lint(good))
+    # The live tree: every fed/ and parallel/ fold goes through the
+    # algebra (the round-21 refactor's enforcement bit).
+    engine = LintEngine(rules=[rules_by_id()["AGG001"]])
+    modules = engine.load_modules(
+        [os.path.join(REPO, "fedcrack_tpu")], rel_to=REPO
+    )
+    assert engine.lint_modules(modules) == []
+
+
 # ---- lock-order pack (project scope: lint_modules, not lint_source) ----
 
 CYCLE_SRC = """\

@@ -832,3 +832,39 @@ def test_committed_r20_artifact_lowp_kernels_contract():
         assert isinstance(gate["passed"], bool) and 0.0 <= gate["iou"] <= 1.0
     assert set(lowp["speedup_vs_reference"]) == set(impls) - {"reference"}
     assert lowp["flops_per_forward_canonical"] > 0
+
+
+def test_committed_r21_artifact_robust_aggregation_contract():
+    """The round-21 acceptance pin: the committed CPU-smoke artifact ran
+    every section (skipped == []), the 4-arm A/B shows the FedAvg arm
+    cliffing where every robust/quarantine arm holds the canary at >= 0.9
+    with drag cut >= 10x, the quarantine arm's exclusion is visible end to
+    end (history map -> ledger count -> health-report join) with the
+    poisoned sender NOT_WAIT-resynced, and the colluding-minority variant
+    is beaten by every robust arm at n >= 2f+3."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "bench_runs", "r21_robust_aggregation_cpu_smoke.json")
+    with open(path) as f:
+        art = json.load(f)
+    assert art["detail"]["skipped"] == []
+    ra = art["detail"]["robust_aggregation"]
+    assert "error" not in ra
+    arms = ra["arms"]
+    assert {"fedavg", "trimmed_mean", "krum", "fedavg_quarantine"} <= set(arms)
+    assert ra["fedavg_cliffed"] and arms["fedavg"]["canary_iou"] < 0.9
+    assert arms["fedavg"]["drag"] > 100.0  # the x1000 poison lands in full
+    for name in ("trimmed_mean", "krum", "fedavg_quarantine"):
+        arm = arms[name]
+        assert arm["canary_iou"] >= 0.9, name
+        assert arm["drag_reduction_vs_fedavg"] >= 10.0, name
+    assert ra["robust_arms_hold"] and ra["drag_reduced_10x"]
+    quar = arms["fedavg_quarantine"]
+    assert quar["quarantined"] and quar["poisoned_resynced_not_wait"]
+    assert quar["ledger_quarantined_count"] >= 1
+    assert quar["honest_not_quarantined"] and quar["clean_global_attached"]
+    coll = ra["colluding"]
+    assert len(coll["colluders"]) * 2 + 3 <= coll["n_clients"]
+    assert all(coll["colluders_beaten"].values())
+    health = ra["health_report"]
+    assert health["schema_violations"] == [] and health["exclusion_visible"]
+    assert set(coll["colluders"]) <= set(health["quarantined_clients"])

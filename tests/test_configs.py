@@ -117,3 +117,21 @@ def test_c16_lowp_kernels_preset_round_trips_with_kernel_plane():
     assert cfg.serve.quant == "int8"  # fused planes require int8 sidecars
     assert cfg.serve.quant_iou_floor == 0.98  # the production floor
     assert FedConfig.from_json(cfg.to_json()) == cfg
+
+
+def test_c17_robust_aggregation_preset_round_trips():
+    """The round-21 robust-aggregation preset: trimmed-mean at the root
+    plus the ledger-coupled quarantine gate. The new knobs travel in-band
+    like every other FedConfig field; pre-r21 configs load with the
+    bitwise-pinned "fedavg" default and quarantine disabled."""
+    path = os.path.join(ROOT, "configs", "c17_robust_aggregation.json")
+    with open(path) as f:
+        cfg = FedConfig.from_json(f.read())
+    assert cfg.aggregation == "trimmed_mean"
+    assert cfg.trim_fraction == 0.2
+    assert cfg.quarantine_z == 3.5  # the Iglewicz-Hoaglin alert cutoff
+    assert FedConfig.from_json(cfg.to_json()) == cfg
+    # A pre-r21 preset (no aggregation keys) keeps the seed behavior.
+    with open(os.path.join(ROOT, "configs", "c13_buffered_async.json")) as f:
+        old = FedConfig.from_json(f.read())
+    assert old.aggregation == "fedavg" and old.quarantine_z == 0.0

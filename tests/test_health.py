@@ -475,3 +475,63 @@ def test_health_report_round_trip(tmp_path):
     assert any(
         "conservation" in v for v in health_report.validate_report(broken)
     )
+
+
+def test_health_report_joins_quarantine(tmp_path):
+    """Round 21: a ledger carrying quarantine counters round-trips
+    through the joined report — per-client counts typed, the summary's
+    quarantines total + quarantined_clients join, schema clean — and a
+    wrong-typed counter trips the guard."""
+    from fedcrack_tpu.tools import health_report
+
+    ledger = {"a": hl.new_record(), "b": hl.new_record()}
+    ledger = hl.record_quarantine(ledger, "b")
+    ledger = hl.record_quarantine(ledger, "b")
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    hl.write_ledger_jsonl(ledger, ledger_path)
+    report = health_report.build_report(ledger_path)
+    assert health_report.validate_report(report) == []
+    assert report["clients"]["b"]["quarantined"] == 2
+    assert report["summary"]["quarantines"] == 2
+    assert report["summary"]["quarantined_clients"] == ["b"]
+    broken = json.loads(json.dumps(report))
+    broken["clients"]["b"]["quarantined"] = "2"
+    assert any(
+        "quarantined" in v for v in health_report.validate_report(broken)
+    )
+
+
+# ---------- the robust-aggregation A/B drill: response layer, end to end ----
+
+
+def test_robust_aggregation_drill_end_to_end():
+    """The round-21 acceptance chain in one artifact: the identical
+    poisoned cohort cliffs the canary under FedAvg but holds IoU >= 0.9
+    under trimmed-mean / Krum / the ledger-coupled quarantine, with drag
+    cut >= 10x; the quarantined flush-trigger is resynced NOT_WAIT; the
+    colluding-minority variant is beaten by every robust arm; and the
+    exclusion shows up in the joined health report."""
+    from fedcrack_tpu.tools.chaos_drill import run_robust_aggregation_drill
+
+    out = run_robust_aggregation_drill()
+    assert out["fedavg_cliffed"]
+    assert out["robust_arms_hold"]
+    assert out["drag_reduced_10x"]
+    arms = out["arms"]
+    assert arms["fedavg"]["canary_iou"] < 0.5 <= out["reference_iou"]
+    for name in ("trimmed_mean", "krum", "fedavg_quarantine"):
+        assert arms[name]["canary_iou"] >= 0.9
+        assert arms[name]["drag_reduction_vs_fedavg"] >= 10.0
+    q = arms["fedavg_quarantine"]
+    assert q["quarantined"] and "c" in q["quarantined"]
+    assert q["poisoned_resynced_not_wait"] and q["clean_global_attached"]
+    assert q["ledger_quarantined_count"] == 1 and q["honest_not_quarantined"]
+    assert all(out["colluding"]["colluders_beaten"].values())
+    hp = out["health_report"]
+    assert hp["schema_violations"] == [] and hp["exclusion_visible"]
+
+    # The drill's artifact is exactly what bench.py commits: schema-check
+    # it with the same validator the committed artifact tests use.
+    import bench
+
+    assert bench.validate_detail({"robust_aggregation": out}) == []
