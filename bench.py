@@ -111,6 +111,9 @@ FEDCRACK_BENCH_SERVE_MAX_BATCH=8 FEDCRACK_BENCH_SERVE_CONCURRENCY=8
 FEDCRACK_BENCH_SERVE_FLEET=0 (skip the round-17 fleet/quant section)
 FEDCRACK_BENCH_FLEET_REPLICAS=1,2 FEDCRACK_BENCH_FLEET_REQUESTS=64
 FEDCRACK_BENCH_FLEET_SHED_RATE=40 (ramp-profile base rate, rps)
+FEDCRACK_BENCH_ELASTIC=0 (skip the round-22 elastic-fleet diurnal A/B +
+shadow-delivery section, detail.elastic_fleet)
+FEDCRACK_BENCH_ELASTIC_REQUESTS=120 FEDCRACK_BENCH_ELASTIC_RATE=24
 FEDCRACK_BENCH_COMPRESSION=0 (skip the update-compression A/B)
 FEDCRACK_BENCH_COMPRESSION_ROUNDS=3 (mesh-twin trajectory rounds).
 FEDCRACK_BENCH_OBSERVABILITY=0 (skip the round-15 concurrent mini-soak)
@@ -177,6 +180,7 @@ DETAIL_SCHEMA: dict = {
     "chaos_recovery": dict,
     "serving": dict,
     "serve_fleet": dict,
+    "elastic_fleet": dict,
     "update_compression": dict,
     "cohort_scale": dict,
     "async_federation": dict,
@@ -405,6 +409,54 @@ SERVE_FLEET_ARM_SCHEMA: dict = {
     "p50_ms": (int, float, type(None)),
     "p95_ms": (int, float, type(None)),
 }
+# Typed keys of detail.elastic_fleet (round 22): the SLO-driven autoscaler
+# + shadow-delivery contract — the 3-arm diurnal A/B (static-max holds the
+# profile by burning replicas, static-min sheds at the peak, the autoscaled
+# arm holds p95 with zero sheds and zero drops at STRICTLY lower
+# replica-seconds than static-max), the autoscaler's full action audit,
+# and the shadow-replica verdicts (one promote, one rollback, each with
+# the deciding iou/psi/latency deltas).
+ELASTIC_FLEET_SCHEMA: dict = {
+    "profile": str,
+    "rate_rps": (int, float),
+    "requests": int,
+    "slo_p95_ms": (int, float),
+    "queue_bound": int,
+    "arms": dict,
+    "autoscaler": dict,
+    "autoscaled_cheaper_than_static_max": bool,
+    "autoscaled_held_slo": bool,
+    "static_min_shed": bool,
+    "shadow": dict,
+}
+# Per-arm keys of detail.elastic_fleet.arms.*. `replica_seconds` is the
+# cost integral: live-replicas x wall for the autoscaled arm (the
+# controller's meter), replicas x wall for the static arms. `replicas_*`
+# come from load_gen's --metrics-url sampler polling the live
+# serve_fleet_replicas gauge — `replicas_varied` True on the autoscaled
+# arm is the wire-level proof the fleet actually resized mid-profile.
+ELASTIC_ARM_SCHEMA: dict = {
+    "replicas_band": list,
+    "completed": int,
+    "shed": int,
+    "dropped": int,
+    "p95_ms": (int, float, type(None)),
+    "wall_s": (int, float),
+    "replica_seconds": (int, float),
+    "replicas_min": (int, type(None)),
+    "replicas_max": (int, type(None)),
+    "replicas_varied": bool,
+}
+# Required keys of detail.elastic_fleet.shadow: the progressive-delivery
+# pins. Each record is a ShadowController verdict — iou vs the production
+# payload's canary, drift PSI on the shared probe batch, the shadow-lane
+# latency factor, and the reasons that decided it.
+ELASTIC_SHADOW_SCHEMA: dict = {
+    "promote": dict,
+    "rollback": dict,
+    "promoted": bool,
+    "rolled_back": bool,
+}
 # Typed keys of detail.video_serving (round 19): the frame-coherent video
 # contract — the stateless-vs-cached-session A/B over a seeded
 # >=90%-overlap sequence, the per-frame byte-identity audit spanning a
@@ -510,6 +562,39 @@ def validate_detail(detail: dict) -> list:
                     bad.append(
                         f"serve_fleet.grid[{name!r}][{key!r}]: "
                         f"{type(point[key]).__name__}"
+                    )
+    elastic = detail.get("elastic_fleet")
+    if isinstance(elastic, dict) and "error" not in elastic:
+        for key, typs in ELASTIC_FLEET_SCHEMA.items():
+            if key not in elastic:
+                bad.append(f"elastic_fleet[{key!r}] missing")
+            elif not isinstance(elastic[key], typs):
+                bad.append(f"elastic_fleet[{key!r}]: {type(elastic[key]).__name__}")
+        arms = elastic.get("arms")
+        if isinstance(arms, dict) and not arms:
+            bad.append("elastic_fleet['arms'] is empty")
+        for name, point in (arms if isinstance(arms, dict) else {}).items():
+            if not isinstance(point, dict):
+                # Report, never TypeError — the r12 wire-map contract.
+                bad.append(f"elastic_fleet.arms[{name!r}]: {type(point).__name__}")
+                continue
+            for key, typs in ELASTIC_ARM_SCHEMA.items():
+                if key not in point:
+                    bad.append(f"elastic_fleet.arms[{name!r}][{key!r}] missing")
+                elif not isinstance(point[key], typs):
+                    bad.append(
+                        f"elastic_fleet.arms[{name!r}][{key!r}]: "
+                        f"{type(point[key]).__name__}"
+                    )
+        shadow = elastic.get("shadow")
+        if isinstance(shadow, dict):
+            for key, typs in ELASTIC_SHADOW_SCHEMA.items():
+                if key not in shadow:
+                    bad.append(f"elastic_fleet.shadow[{key!r}] missing")
+                elif not isinstance(shadow[key], typs):
+                    bad.append(
+                        f"elastic_fleet.shadow[{key!r}]: "
+                        f"{type(shadow[key]).__name__}"
                     )
     comp = detail.get("update_compression")
     if isinstance(comp, dict) and "error" not in comp:
@@ -853,6 +938,19 @@ FLEET_REPLICAS = tuple(
 )
 FLEET_REQUESTS = int(os.environ.get("FEDCRACK_BENCH_FLEET_REQUESTS", "64"))
 FLEET_SHED_RATE = float(os.environ.get("FEDCRACK_BENCH_FLEET_SHED_RATE", "40"))
+
+# Elastic-fleet section (round 22, detail.elastic_fleet): the 3-arm diurnal
+# A/B — static-max vs static-min vs autoscaled — through the real gRPC
+# front door with load_gen's diurnal profile and its --metrics-url replica
+# sampler, plus the shadow-replica progressive-delivery pins (one candidate
+# auto-promoted, one deliberately-degraded candidate auto-rolled-back).
+# The model is deliberately tiny and every dispatch chaos-throttled: the
+# section certifies the CONTROL LOOP (scale before shed, drain without
+# drops, strictly fewer replica-seconds than static-max), not model
+# throughput. "0" opts out.
+ELASTIC = os.environ.get("FEDCRACK_BENCH_ELASTIC", "1") == "1"
+ELASTIC_REQUESTS = int(os.environ.get("FEDCRACK_BENCH_ELASTIC_REQUESTS", "120"))
+ELASTIC_RATE = float(os.environ.get("FEDCRACK_BENCH_ELASTIC_RATE", "24"))
 
 # Video-serving section (round 19, detail.video_serving): the frame-coherent
 # session A/B — stateless predict_tiled vs the per-stream tile-cached
@@ -2891,6 +2989,229 @@ def _bench_serve_fleet(device) -> dict:
     }
 
 
+def _bench_elastic_fleet(device) -> dict:
+    """Elastic serve fleet (round 22, detail.elastic_fleet).
+
+    Two halves over one deliberately tiny model (dispatches chaos-throttled
+    to 80 ms so capacity is REPLICA-bound, not model-bound — the section
+    certifies the control loop, never CPU throughput):
+
+    - **diurnal A/B**: the same seeded compressed-day arrival profile
+      (night/morning/peak/evening at 0.2x/1x/1.8x/0.8x of the base rate)
+      through the real gRPC front door three times — static-max (burns
+      ``max`` replicas all day), static-min (one replica: the 1.8x peak
+      MUST overrun its queue bound and shed), and autoscaled (starts at
+      min, the FleetAutoscaler grows/shrinks the fleet live from the
+      registry's own exposition). load_gen's ``--metrics-url`` sampler
+      polls ``serve_fleet_replicas`` through each run — the autoscaled
+      arm's ``replicas_varied`` is wire-level proof the fleet resized.
+      The claims: autoscaled holds p95 with shed == 0 and dropped == 0 at
+      STRICTLY lower replica-seconds than static-max; static-min sheds.
+    - **shadow delivery**: a ShadowController stages one candidate that
+      matches production (mirrored live traffic, canary IoU 1.0, zero
+      drift → auto-PROMOTE installs it) and one deliberately degraded
+      candidate (zeroed weights → IoU cliff + PSI blowout → auto-ROLLBACK,
+      never installed, clients never see a shadow answer). Both full
+      verdict records land in the artifact.
+    """
+    import dataclasses
+    import threading
+
+    from fedcrack_tpu.configs import ModelConfig, ServeConfig
+    from fedcrack_tpu.models.resunet import init_variables
+    from fedcrack_tpu.obs.promexp import MetricsExporter
+    from fedcrack_tpu.obs.registry import REGISTRY
+    from fedcrack_tpu.serve import (
+        FleetAutoscaler,
+        InferenceEngine,
+        ServeFleet,
+        ServeServer,
+        ServeServerThread,
+        ServeService,
+        ShadowController,
+    )
+    from fedcrack_tpu.tools.load_gen import make_images, run_load
+
+    model_config = ModelConfig(
+        img_size=16,
+        stem_features=4,
+        encoder_features=(8,),
+        decoder_features=(8, 4),
+    )
+    slo_ms = 1500.0
+    base_cfg = ServeConfig(
+        bucket_sizes=(16,),
+        max_batch=2,
+        max_delay_ms=5.0,
+        tile_overlap=4,
+        # 16 open-loop client streams bound in-flight requests at 16, so a
+        # bound of 10 is reachable by a one-replica backlog at the 1.8x
+        # peak (static-min MUST shed) while the autoscaler's queue trigger
+        # (2 x live <= 6) fires well inside it (autoscaled must NOT).
+        queue_bound=10,
+        slo_p95_ms=slo_ms,
+        port=0,
+    )
+    v0 = init_variables(jax.random.key(SEED), model_config)
+    engine = InferenceEngine(model_config, base_cfg)
+
+    class _SlowBatches:
+        """Stretch every dispatch so a replica's service rate is the
+        throttle (max_batch/0.08s ~ 25 rps), making the 1.8x peak a real
+        capacity cliff a tiny CPU model would otherwise never feel."""
+
+        def on_batch(self, bucket, batch_index, attempt):
+            time.sleep(0.08)
+
+    exporter = MetricsExporter(REGISTRY)
+    metrics_url = f"http://127.0.0.1:{exporter.start()}/metrics"
+    arms: dict[str, dict] = {}
+    auto_audit: dict = {}
+
+    def run_arm(name: str, *, replicas: int, min_r: int = 0, max_r: int = 0):
+        cfg = dataclasses.replace(
+            base_cfg,
+            replicas=replicas,
+            min_replicas=min_r,
+            max_replicas=max_r,
+            scale_interval_s=0.05,
+            scale_cooldown_s=0.15,
+            scale_up_queue_depth=2,
+            scale_down_idle_evals=6,
+        )
+        fleet = ServeFleet(
+            model_config, cfg, v0, shared_engine=engine, chaos=_SlowBatches()
+        )
+        server = ServeServer(
+            ServeService(fleet.engine, fleet.router, fleet.manager), port=0
+        )
+        autoscaler = None
+        try:
+            if min_r > 0:
+                autoscaler = FleetAutoscaler(fleet)
+                autoscaler.start()
+            with ServeServerThread(server) as thread:
+                summary = run_load(
+                    f"127.0.0.1:{thread.port}",
+                    mode="open",
+                    profile="diurnal",
+                    n_requests=ELASTIC_REQUESTS,
+                    rate_rps=ELASTIC_RATE,
+                    concurrency=16,
+                    sizes=(16,),
+                    seed=SEED,
+                    metrics_url=metrics_url,
+                    metrics_interval_s=0.25,
+                )
+            replica_seconds = (
+                autoscaler.replica_seconds()
+                if autoscaler is not None
+                else replicas * summary["wall_s"]
+            )
+        finally:
+            if autoscaler is not None:
+                autoscaler.stop()
+            fleet.close()
+        fleet_block = summary.get("fleet") or {}
+        fleet_block.pop("track", None)  # per-sample detail; keep artifact lean
+        arms[name] = {
+            "replicas_band": [min_r or replicas, max_r or replicas],
+            "completed": summary["completed"],
+            "shed": summary["shed"],
+            "dropped": summary["dropped"],
+            "p95_ms": (summary["latency_ms"] or {}).get("p95"),
+            "wall_s": summary["wall_s"],
+            "replica_seconds": round(replica_seconds, 3),
+            "replicas_min": fleet_block.get("replicas_min"),
+            "replicas_max": fleet_block.get("replicas_max"),
+            "replicas_varied": bool(fleet_block.get("replicas_varied")),
+            "per_phase": summary["per_phase"],
+            "shed_by_reason": fleet.router.shed_counts(),
+        }
+        if autoscaler is not None:
+            auto_audit.update(autoscaler.audit())
+
+    run_arm("static_max", replicas=3)
+    run_arm("static_min", replicas=1)
+    run_arm("autoscaled", replicas=1, min_r=1, max_r=3)
+    exporter.stop()
+
+    # ---- shadow-replica progressive delivery: one promote, one rollback,
+    # under live mirrored traffic (no throttle — the mirror needs samples,
+    # not backlog) ----
+    shadow_cfg = dataclasses.replace(
+        base_cfg, replicas=1, shadow_fraction=1.0, shadow_min_samples=8
+    )
+    sfleet = ServeFleet(model_config, shadow_cfg, v0, shared_engine=engine)
+    ctrl = ShadowController(sfleet)
+    pump_imgs = make_images(8, (16,), SEED)
+    stop_pump = threading.Event()
+
+    def pump():
+        i = 0
+        while not stop_pump.is_set():
+            try:
+                sfleet.submit(pump_imgs[i % len(pump_imgs)]).result(timeout=30)
+            except Exception:
+                pass
+            i += 1
+
+    pump_thread = threading.Thread(target=pump, daemon=True)
+    pump_thread.start()
+    try:
+        # A candidate indistinguishable from production (a re-publish):
+        # IoU pins at 1.0, PSI at 0 — the promote path.
+        promote_rec = ctrl.stage(1, v0, wait_s=15.0)
+        # A deliberately degraded candidate: zeroed weights crater the
+        # canary IoU and blow out the drift PSI — the rollback path.
+        v_bad = jax.tree_util.tree_map(lambda x: x * 0, v0)
+        rollback_rec = ctrl.stage(2, v_bad, wait_s=15.0)
+    finally:
+        stop_pump.set()
+        pump_thread.join(timeout=10)
+        sfleet.close()
+    # Verdict records carry model outputs' floats; round-trip through JSON
+    # (numpy scalars -> floats) so the artifact writer never trips.
+    promote_rec = json.loads(json.dumps(promote_rec, default=float))
+    rollback_rec = json.loads(json.dumps(rollback_rec, default=float))
+    shadow = {
+        "promote": promote_rec,
+        "rollback": rollback_rec,
+        "promoted": promote_rec.get("verdict") == "promote"
+        and bool(promote_rec.get("installed")),
+        "rolled_back": rollback_rec.get("verdict") == "rollback"
+        and not rollback_rec.get("installed"),
+    }
+
+    auto = arms["autoscaled"]
+    return {
+        "profile": "diurnal",
+        "rate_rps": ELASTIC_RATE,
+        "requests": ELASTIC_REQUESTS,
+        "slo_p95_ms": slo_ms,
+        "queue_bound": base_cfg.queue_bound,
+        "arms": arms,
+        "autoscaler": auto_audit,
+        "autoscaled_cheaper_than_static_max": (
+            auto["replica_seconds"] < arms["static_max"]["replica_seconds"]
+        ),
+        "autoscaled_held_slo": (
+            auto["shed"] == 0
+            and auto["dropped"] == 0
+            and auto["p95_ms"] is not None
+            and auto["p95_ms"] <= slo_ms
+        ),
+        "static_min_shed": arms["static_min"]["shed"] > 0,
+        "shadow": shadow,
+        "note": (
+            "dispatches chaos-throttled to 80 ms so capacity is replica-"
+            "bound: the section certifies the control loop (scale before "
+            "shed, drain without drops, fewer replica-seconds than "
+            "static-max) on a CPU smoke; absolute rps is not a claim"
+        ),
+    }
+
+
 def _bench_update_compression(rounds: int = COMPRESSION_ROUNDS) -> dict:
     """Compressed update transport A/B (round 12, fedcrack_tpu/compress).
 
@@ -3767,6 +4088,32 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
         else:
             _skip(
                 skips, "serve_fleet", fleet_est, "estimate exceeds remaining budget"
+            )
+
+    # ---- elastic fleet (round 22): the 3-arm diurnal A/B (static-max vs
+    # static-min vs autoscaled) through the gRPC front door plus the
+    # shadow-replica promote/rollback pins. The model is tiny (host-scale
+    # compile); wall is dominated by the seeded diurnal schedule itself —
+    # ~2*requests/rate per arm — plus the two shadow stagings ----
+    if ELASTIC:
+        elastic_est = (
+            COMPILE_EST_S
+            + 3 * 2.2 * ELASTIC_REQUESTS / max(1.0, ELASTIC_RATE)
+            + 40.0
+        )
+        if _fits(elastic_est):
+            t0 = time.monotonic()
+            try:
+                detail["elastic_fleet"] = _bench_elastic_fleet(device)
+            except Exception as e:  # never kills the artifact
+                detail["elastic_fleet"] = {"error": repr(e)}
+            section_s["elastic_fleet"] = time.monotonic() - t0
+            detail["budget"] = _budget_detail()
+            _set_payload(metric_headline, value, vs_baseline, detail)
+        else:
+            _skip(
+                skips, "elastic_fleet", elastic_est,
+                "estimate exceeds remaining budget",
             )
 
     # ---- video serving (round 19): the frame-coherent session plane —

@@ -18,6 +18,7 @@ def all_rules() -> list[Rule]:
         deadcode,
         determinism,
         durability,
+        fleet_plane,
         health_plane,
         kernel_plane,
         locks,
@@ -31,7 +32,7 @@ def all_rules() -> list[Rule]:
     for pack in (
         determinism, durability, trace, transport, compress, async_plane,
         obs_plane, health_plane, agg_plane, locks, deadcode, serve_plane,
-        kernel_plane,
+        kernel_plane, fleet_plane,
     ):
         out.extend(cls() for cls in pack.RULES)
     return out

@@ -69,6 +69,19 @@ SERVE_STREAM_RESET = "serve_stream_reset"
 # replica index to kill.
 SERVE_REPLICA_CRASH = "serve_replica_crash"
 
+# Elastic-fleet plane (round 22). Scenario-harness kinds consumed by
+# tools/chaos_drill.run_elastic_fleet_drill:
+# - REPLICA_CRASH_DURING_SCALE: a replica crash fired CONCURRENTLY with an
+#   autoscaler scale-down — two drains race on the same router, and the
+#   drill pins that zero accepted requests drop either way. `round` is the
+#   replica index to crash.
+# - SHADOW_REPLICA_CRASH: the shadow candidate lane dies mid-staging (its
+#   batcher closed under the live mirror). The drill pins that production
+#   answers and latency are untouched and the verdict degrades to a loud
+#   rollback, never a promote. `round` is 0 (one shadow lane at a time).
+REPLICA_CRASH_DURING_SCALE = "replica_crash_during_scale"
+SHADOW_REPLICA_CRASH = "shadow_replica_crash"
+
 # Aggregation-tree plane (round 13). Like the server kill, a dead edge
 # process cannot run an in-process hook — this kind is consumed by the
 # scenario harnesses (tools/chaos_drill.run_edge_crash_drill,
@@ -110,7 +123,9 @@ SERVE_KINDS = frozenset({SERVE_SWAP_MIDFLIGHT, SERVE_DEVICE_LOSS})
 # no hook); `client` carries the edge id.
 TREE_KINDS = frozenset({EDGE_AGGREGATOR_CRASH})
 STORM_KINDS = frozenset({STRAGGLER_STORM})
-FLEET_KINDS = frozenset({SERVE_REPLICA_CRASH})
+FLEET_KINDS = frozenset(
+    {SERVE_REPLICA_CRASH, REPLICA_CRASH_DURING_SCALE, SHADOW_REPLICA_CRASH}
+)
 STREAM_KINDS = frozenset({SERVE_STREAM_RESET})
 ALL_KINDS = (
     CLIENT_KINDS

@@ -192,6 +192,44 @@ class ServeConfig:
     # frame t+1 continues the track whose last centroid lies within this
     # fraction of the frame diagonal; beyond it a new stable id is born.
     stream_track_match_frac: float = 0.05
+    # ---- Elastic fleet (round 22, serve/autoscaler.py) ----
+    # SLO-driven autoscaling between min_replicas and max_replicas: the
+    # controller consumes the registry's own Prometheus exposition (rolling
+    # p95, per-bucket queue depth, live replica count) and scales the fleet
+    # — scale-up compiles + warms the new replica OFF the serving path,
+    # scale-down drains via the kill/reroute machinery so zero accepted
+    # requests drop. min_replicas=0 disarms the controller entirely (the
+    # static round-17 fleet); armed, `replicas` is the boot size and must
+    # sit inside [min_replicas, max_replicas].
+    min_replicas: int = 0
+    max_replicas: int = 0
+    # Controller evaluation period and the cooldown after ANY scale action
+    # (hysteresis against flap storms — a gust can trigger at most one
+    # action per cooldown window).
+    scale_interval_s: float = 1.0
+    scale_cooldown_s: float = 5.0
+    # Scale-up triggers: queued backlog per live replica reaching this, or
+    # the rolling p95 reaching this fraction of slo_p95_ms (act BEFORE the
+    # shed probe does — shed stays the loud backstop, never the steady
+    # state).
+    scale_up_queue_depth: int = 4
+    scale_up_p95_frac: float = 0.8
+    # Scale-down hysteresis: this many CONSECUTIVE calm evaluations (empty
+    # queues, p95 comfortably under the trigger) before one replica drains.
+    scale_down_idle_evals: int = 3
+    # ---- Shadow-replica progressive delivery (round 22, serve/shadow.py) --
+    # Fraction of admitted production traffic mirrored to the shadow
+    # candidate (responses NEVER returned to clients); 0 disables staging —
+    # published versions install directly, the round-17 behavior.
+    shadow_fraction: float = 0.0
+    # Mirrored completions required before a promote/rollback verdict.
+    shadow_min_samples: int = 16
+    # Verdict floors: candidate canary IoU vs the production reference,
+    # max PSI delta between candidate and production probe profiles, and
+    # the shadow-vs-production p95 latency ratio ceiling.
+    shadow_iou_floor: float = 0.98
+    shadow_psi_ceiling: float = 0.25
+    shadow_latency_factor: float = 3.0
 
     def __post_init__(self) -> None:
         if not self.bucket_sizes:
@@ -273,6 +311,72 @@ class ServeConfig:
             raise ValueError(
                 f"stream_track_match_frac must be in (0, 1], got "
                 f"{self.stream_track_match_frac}"
+            )
+        if self.min_replicas < 0 or self.max_replicas < 0:
+            raise ValueError(
+                f"min_replicas/max_replicas must be >= 0, got "
+                f"{self.min_replicas}/{self.max_replicas}"
+            )
+        if self.min_replicas > 0:
+            if self.max_replicas < self.min_replicas:
+                raise ValueError(
+                    f"max_replicas={self.max_replicas} must be >= "
+                    f"min_replicas={self.min_replicas}"
+                )
+            if not self.min_replicas <= self.replicas <= self.max_replicas:
+                raise ValueError(
+                    f"replicas={self.replicas} (the boot size) must sit in "
+                    f"[min_replicas={self.min_replicas}, "
+                    f"max_replicas={self.max_replicas}]"
+                )
+        elif self.max_replicas > 0:
+            raise ValueError(
+                "max_replicas without min_replicas is a disarmed ceiling — "
+                "set min_replicas >= 1 to arm the autoscaler"
+            )
+        if self.scale_interval_s <= 0:
+            raise ValueError(
+                f"scale_interval_s must be > 0, got {self.scale_interval_s}"
+            )
+        if self.scale_cooldown_s < 0:
+            raise ValueError(
+                f"scale_cooldown_s must be >= 0, got {self.scale_cooldown_s}"
+            )
+        if self.scale_up_queue_depth < 1:
+            raise ValueError(
+                f"scale_up_queue_depth must be >= 1, got "
+                f"{self.scale_up_queue_depth}"
+            )
+        if not 0.0 < self.scale_up_p95_frac <= 1.0:
+            raise ValueError(
+                f"scale_up_p95_frac must be in (0, 1], got "
+                f"{self.scale_up_p95_frac}"
+            )
+        if self.scale_down_idle_evals < 1:
+            raise ValueError(
+                f"scale_down_idle_evals must be >= 1, got "
+                f"{self.scale_down_idle_evals}"
+            )
+        if not 0.0 <= self.shadow_fraction <= 1.0:
+            raise ValueError(
+                f"shadow_fraction must be in [0, 1], got {self.shadow_fraction}"
+            )
+        if self.shadow_min_samples < 1:
+            raise ValueError(
+                f"shadow_min_samples must be >= 1, got {self.shadow_min_samples}"
+            )
+        if not 0.0 < self.shadow_iou_floor <= 1.0:
+            raise ValueError(
+                f"shadow_iou_floor must be in (0, 1], got {self.shadow_iou_floor}"
+            )
+        if self.shadow_psi_ceiling <= 0:
+            raise ValueError(
+                f"shadow_psi_ceiling must be > 0, got {self.shadow_psi_ceiling}"
+            )
+        if self.shadow_latency_factor < 1.0:
+            raise ValueError(
+                f"shadow_latency_factor must be >= 1, got "
+                f"{self.shadow_latency_factor}"
             )
 
 

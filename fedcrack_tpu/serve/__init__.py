@@ -18,8 +18,15 @@ The subsystem that turns the training stack's outputs into the ROADMAP's
   reference oracle (round 17);
 - :mod:`fedcrack_tpu.serve.fleet` / :mod:`fedcrack_tpu.serve.router` —
   the multi-replica fleet: least-outstanding routing, SLO load shedding,
-  fleet-wide two-phase coordinated hot swap (round 17).
+  fleet-wide two-phase coordinated hot swap (round 17);
+- :mod:`fedcrack_tpu.serve.autoscaler` /
+  :mod:`fedcrack_tpu.serve.shadow` — the elastic fleet: SLO-driven
+  scale-up/down between ``min_replicas``/``max_replicas``, and
+  shadow-replica progressive delivery with metric-gated auto-promote /
+  auto-rollback (round 22).
 """
+
+from fedcrack_tpu.serve.autoscaler import FleetAutoscaler  # noqa: F401
 
 from fedcrack_tpu.serve.batcher import (  # noqa: F401
     MicroBatcher,
@@ -43,6 +50,10 @@ from fedcrack_tpu.serve.quant import (  # noqa: F401
     quantize_variables,
 )
 from fedcrack_tpu.serve.router import FleetRouter, LoadShedError  # noqa: F401
+from fedcrack_tpu.serve.shadow import (  # noqa: F401
+    ShadowController,
+    ShadowMirror,
+)
 from fedcrack_tpu.serve.service import (  # noqa: F401
     ServeServer,
     ServeServerThread,

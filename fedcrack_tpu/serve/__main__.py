@@ -53,6 +53,29 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--quant-iou-floor", type=float)
     p.add_argument(
+        "--min-replicas",
+        type=int,
+        help="arm the round-22 SLO autoscaler: fleet floor (>= 1; pairs "
+        "with --max-replicas; --replicas is the boot size inside the band)",
+    )
+    p.add_argument(
+        "--max-replicas",
+        type=int,
+        help="autoscaler fleet ceiling (>= --min-replicas)",
+    )
+    p.add_argument("--scale-interval-s", type=float,
+                   help="autoscaler control-loop period")
+    p.add_argument("--scale-cooldown-s", type=float,
+                   help="dead time after any scaling action (anti-flap)")
+    p.add_argument(
+        "--shadow-fraction",
+        type=float,
+        help="arm round-22 progressive delivery: fraction of admitted "
+        "traffic mirrored to a shadow candidate lane (> 0; publishes then "
+        "stage through shadow and auto-promote/auto-rollback instead of "
+        "installing directly)",
+    )
+    p.add_argument(
         "--slo-p95-ms",
         type=float,
         help="shed (RESOURCE_EXHAUSTED) when rolling p95 breaches this; 0 off",
@@ -139,6 +162,16 @@ def resolve_config(args):
         overrides["stream_cache_tiles"] = args.stream_cache_tiles
     if args.stream_max_sessions is not None:
         overrides["stream_max_sessions"] = args.stream_max_sessions
+    if args.min_replicas is not None:
+        overrides["min_replicas"] = args.min_replicas
+    if args.max_replicas is not None:
+        overrides["max_replicas"] = args.max_replicas
+    if args.scale_interval_s is not None:
+        overrides["scale_interval_s"] = args.scale_interval_s
+    if args.scale_cooldown_s is not None:
+        overrides["scale_cooldown_s"] = args.scale_cooldown_s
+    if args.shadow_fraction is not None:
+        overrides["shadow_fraction"] = args.shadow_fraction
     if overrides:
         serve = dataclasses.replace(serve, **overrides)
     return fed.model, serve
@@ -203,9 +236,15 @@ async def _serve(args) -> int:
         metrics = MetricsLogger(args.metrics_path)
 
     fleet = None
-    if serve_config.replicas > 1 or serve_config.quant != "none":
+    if (
+        serve_config.replicas > 1
+        or serve_config.quant != "none"
+        or serve_config.min_replicas > 0
+        or serve_config.shadow_fraction > 0
+    ):
         # Round-17 fleet topology (also the single-replica quantized shape:
-        # the fleet manager owns the A/B gate).
+        # the fleet manager owns the A/B gate; round 22's autoscaler and
+        # shadow delivery only exist on the fleet shape).
         from fedcrack_tpu.serve.fleet import ServeFleet
 
         fleet = ServeFleet(
@@ -257,7 +296,24 @@ async def _serve(args) -> int:
         port=serve_config.port,
         max_message_mb=serve_config.max_message_mb,
     )
-    manager.start()
+    # Round 22: elastic capacity + progressive delivery on the fleet shape.
+    autoscaler = None
+    shadow_ctrl = None
+    if fleet is not None and serve_config.min_replicas > 0:
+        from fedcrack_tpu.serve.autoscaler import FleetAutoscaler
+
+        autoscaler = FleetAutoscaler(fleet)
+        autoscaler.start()
+    if fleet is not None and serve_config.shadow_fraction > 0:
+        from fedcrack_tpu.serve.shadow import ShadowController
+
+        shadow_ctrl = ShadowController(fleet, metrics=metrics)
+        # The shadow controller RUNS the delivery poll: publishes stage
+        # through the shadow lane and auto-promote/rollback instead of the
+        # manager's install-everything-at-once loop.
+        shadow_ctrl.start()
+    else:
+        manager.start()
     port = await server.start()
     metrics_note = (
         f" metrics_port={exporter.bound_port}" if exporter is not None else ""
@@ -280,6 +336,10 @@ async def _serve(args) -> int:
             pass
     await stop.wait()
     await server.stop()
+    if autoscaler is not None:
+        autoscaler.stop()
+    if shadow_ctrl is not None:
+        shadow_ctrl.stop()
     if fleet is not None:
         fleet.close()
     else:
@@ -291,6 +351,10 @@ async def _serve(args) -> int:
         import json
 
         stats = fleet.stats() if fleet is not None else batcher_like.stats()
+        if autoscaler is not None:
+            stats["autoscaler"] = autoscaler.audit()
+        if shadow_ctrl is not None:
+            stats["shadow"] = shadow_ctrl.audit()
         print(json.dumps({"serve_stats": stats}), flush=True)
         metrics.close()
     return 0

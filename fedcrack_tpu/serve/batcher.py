@@ -398,6 +398,14 @@ class MicroBatcher:
         """Requests waiting in bucket queues (not yet in a batch)."""
         return sum(q.qsize() for q in self._queues.values())
 
+    def queued_by_bucket(self) -> dict[int, int]:
+        """Per-bucket queue depth — the router aggregates these into the
+        ``serve_router_queue_depth_total{bucket=...}`` gauges the
+        autoscaler scrapes
+        (round 22). qsize() is approximate by nature; the controller reads
+        it as a pressure signal, not an accounting truth."""
+        return {size: q.qsize() for size, q in self._queues.items()}
+
     def resubmit(self, req: _Request) -> None:
         """Re-enqueue a request object drained from ANOTHER batcher — the
         router's replica-failover path. The request keeps its submit time,

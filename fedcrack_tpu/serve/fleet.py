@@ -127,6 +127,10 @@ class FleetVersionManager:
         self._replicas: list[Replica] = []
         self._slots: list[tuple[int, Any]] = []
         self._version = -1
+        # Round 22: the last installed HOST weights, retained so a scale-up
+        # (grow_slot) can prepare a brand-new replica's payload without
+        # waiting for the next publish.
+        self._last_host_variables: Any | None = None
         self._swap_ctx: dict[int, str] = {}
         self.swaps: list[dict] = []
         self.last_swap: dict | None = None
@@ -158,10 +162,68 @@ class FleetVersionManager:
             raise RuntimeError("replicas already attached")
         self._replicas = list(replicas)
         self._slots = [(-1, None)] * len(replicas)
+        self._last_host_variables = initial_variables
         payloads, _ = self._prepare_payloads(initial_variables)
         with self._lock:
             self._version = int(initial_version)
             self._slots = [(int(initial_version), p) for p in payloads]
+
+    def grow_slot(self, replica: "Replica") -> None:
+        """Round 22 scale-up: register ONE new replica after boot. The
+        prepare (device placement, honoring the last quant-gate verdict)
+        runs OFF the fleet lock from the retained host weights — serving
+        never pauses for a grow; the slot append is one lock acquisition.
+        ``replica.index`` must be the current fleet size (indices only ever
+        grow; scale-down leaves dead slots behind, exactly like a crash)."""
+        if replica.index != len(self._replicas):
+            raise ValueError(
+                f"grow_slot expects index {len(self._replicas)}, "
+                f"got {replica.index}"
+            )
+        if self._last_host_variables is None:
+            raise RuntimeError("no installed weights to grow a replica from")
+        # A shared engine reuses a live twin's device payload (same buffers,
+        # same compiled programs — the in-process fleet shape); a fresh
+        # engine device-places the retained host weights the same way the
+        # fleet-wide install would have.
+        payload = None
+        for r in self._replicas:
+            if r.engine is replica.engine and r.alive:
+                _, payload = self.snapshot_for(r.index)
+                break
+        if payload is None:
+            payload = self._prepare_one(replica.engine)
+        with self._lock:
+            self._replicas.append(replica)
+            self._slots.append((self._version, payload))
+        from fedcrack_tpu.obs import flight
+
+        flight.note("serve.fleet_grow", replica=replica.index,
+                    version=self.version)
+
+    def _prepare_one(self, engine: InferenceEngine) -> Any:
+        """Device payload for one NEW engine from the retained host weights,
+        replaying the last install's quant decision (a refused gate keeps
+        refusing — growing the fleet must not resurrect a bad program)."""
+        from fedcrack_tpu.serve import quant as quant_mod
+
+        hv = self._last_host_variables
+        if (
+            self.serve_config.quant == "int8"
+            and self.last_quant_gate is not None
+            and self.last_quant_gate.get("passed")
+        ):
+            plane = getattr(engine, "effective_kernel_plane", "reference")
+            return engine.prepare_quantized(
+                quant_mod.quantize_for_plane(hv, plane)
+            )
+        return engine.prepare(hv)
+
+    @property
+    def watcher(self) -> WeightSourceWatcher:
+        """The configured weight source — the shadow controller (round 22)
+        polls it directly when progressive delivery replaces auto-install."""
+        return self._watcher
 
     # ---- serving-path reads ----
 
@@ -267,6 +329,7 @@ class FleetVersionManager:
         current = self.version
         if version <= current:
             return False
+        self._last_host_variables = host_variables
         fctx = tracing.flush_context(version)
         sctx = tracing.TraceContext(fctx.trace, f"fleet-swap:v{version}")
         with tracing.span(
@@ -407,6 +470,8 @@ class ServeFleet:
             template=template,
             metrics=metrics,
         )
+        self._metrics = metrics
+        self._chaos = chaos
         self.replicas = [
             Replica(i, engines[i], self.manager, metrics=metrics, chaos=chaos)
             for i in range(n)
@@ -468,6 +533,47 @@ class ServeFleet:
 
     def install(self, version: int, host_variables: Any) -> bool:
         return self.manager.install(version, host_variables)
+
+    # ---- elastic lifecycle (round 22) ----
+
+    def add_replica(self, *, warm: bool = True) -> Replica:
+        """Scale-up: build, register and (by default) warm ONE new replica
+        entirely OFF the serving path, then publish it to the router — the
+        only sanctioned grow path (fedlint FLEET001). The new replica
+        shares replica 0's engine (one XLA program, another serving lane;
+        the r17 persistent compile cache makes a per-process engine's boot
+        warm the same way), so the router first sees it with its batcher
+        live and its weights slot already committed."""
+        engine = self.replicas[0].engine
+        index = len(self.replicas)
+        replica = Replica(
+            index, engine, self.manager,
+            metrics=self._metrics, chaos=self._chaos,
+        )
+        self.manager.grow_slot(replica)
+        if warm:
+            _, payload = self.manager.snapshot_for(index)
+            engine.warmup(payload)
+        self.replicas.append(replica)
+        # The router-list append lives HERE, not in router.py: FLEET001
+        # pins every replica-set mutation inside serve/fleet.py or
+        # serve/autoscaler.py, and the router's list IS the fleet's.
+        with self.router._lock:
+            self.router.replicas.append(replica)
+            self.router._m_replicas.set(
+                sum(1 for r in self.router.replicas if r.alive)
+            )
+        from fedcrack_tpu.obs import flight
+
+        flight.note("serve.replica_added", replica=index)
+        return replica
+
+    def remove_replica(self, index: int) -> dict:
+        """Scale-down: drain replica ``index`` out of rotation via the
+        router's kill/reroute machinery — queued requests move to survivors
+        with their original futures, so zero ACCEPTED requests drop (the
+        r17 pin the autoscaler leans on). The slot stays behind, dead."""
+        return self.router.kill_replica(index)
 
     def stats(self) -> dict:
         return {

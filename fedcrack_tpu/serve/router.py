@@ -136,6 +136,24 @@ class FleetRouter:
             "live replica workers behind the fleet router",
         )
         self._m_replicas.set(sum(1 for r in self.replicas if r.alive))
+        # Round 22: the very signals admission control acts on, published so
+        # the autoscaler (serve/autoscaler.py) — and any operator — can
+        # scrape them instead of reaching into router internals.
+        self._m_rolling_p95 = REGISTRY.gauge(
+            "serve_rolling_p95_seconds",
+            "rolling windowed p95 served latency the admission probe reads",
+        )
+        # (the batcher's per-replica total already owns the unlabeled
+        # serve_queue_depth_total name; this is the fleet-wide per-bucket
+        # view, suffixed per OBS001's unit vocabulary)
+        self._m_queue_depth = REGISTRY.gauge(
+            "serve_router_queue_depth_total",
+            "queued requests across live replicas per bucket",
+            labels=("bucket",),
+        )
+        # Shadow mirror hook (serve/shadow.py): observe-only; production
+        # answers never depend on it. None = no candidate under evaluation.
+        self._shadow: Any | None = None
 
     # ---- admission control ----
 
@@ -170,6 +188,41 @@ class FleetRouter:
     def shed_counts(self) -> dict:
         with self._lock:
             return dict(self._shed_counts)
+
+    def refresh_gauges(self) -> dict:
+        """Publish the admission signals (rolling p95, per-bucket queue
+        depth) as registry gauges and return them — called by the
+        autoscaler's control loop before it scrapes the exposition, and by
+        anything that wants a coherent read of router pressure. Buckets
+        with empty queues still publish 0 so the series never goes stale."""
+        p95_ms = self.rolling.percentile(95.0)
+        p95_s = (p95_ms if p95_ms is not None else 0.0) / 1e3
+        self._m_rolling_p95.set(p95_s)
+        depths: dict[int, int] = {}
+        for r in self.live_replicas():
+            for size, n in r.batcher.queued_by_bucket().items():
+                depths[size] = depths.get(size, 0) + n
+        for size, n in sorted(depths.items()):
+            self._m_queue_depth.labels(bucket=str(size)).set(n)
+        return {"p95_s": p95_s, "queue_depth": depths}
+
+    # ---- shadow mirroring (round 22) ----
+
+    def attach_shadow(self, mirror: Any) -> None:
+        """Install the shadow mirror hook — an object with
+        ``observe(image_u8)``. The router calls it AFTER a request is
+        admitted and dispatched; the hook's answer (if any) never reaches
+        the client. One mirror at a time; attach replaces."""
+        with self._lock:
+            self._shadow = mirror
+
+    def detach_shadow(self, mirror: Any | None = None) -> None:
+        """Remove the shadow hook. With ``mirror`` given, detach only if it
+        is STILL the attached one — a finished evaluation must not tear
+        down its successor's mirror."""
+        with self._lock:
+            if mirror is None or self._shadow is mirror:
+                self._shadow = None
 
     # ---- dispatch ----
 
@@ -222,6 +275,17 @@ class FleetRouter:
                     raise
                 continue
             fut.add_done_callback(self._on_done)
+            # Mirror AFTER the production dispatch succeeded: the shadow
+            # sees only admitted traffic, and nothing it does — sampling,
+            # submitting to the candidate, crashing — can touch ``fut``.
+            shadow = self._shadow
+            if shadow is not None:
+                try:
+                    shadow.observe(image_u8)
+                except Exception:
+                    # Shadow failures are the shadow plane's problem
+                    # (counted in serve/shadow.py); never the client's.
+                    pass
             return fut
         raise RuntimeError("no live replicas")
 

@@ -837,6 +837,205 @@ def run_replica_crash_drill() -> dict:
         fleet.close()
 
 
+def run_elastic_fleet_drill() -> dict:
+    """Elastic-fleet drill (round 22): REPLICA_CRASH_DURING_SCALE +
+    SHADOW_REPLICA_CRASH.
+
+    Part A — crash racing a scale-down: a 3-replica fleet under threaded
+    load; the autoscaler's scale-down (drains the highest-index replica)
+    races a concurrent crash of ANOTHER replica — two drains contend on
+    one router, and the pin is that every accepted request still answers
+    with its original future (zero drops), exactly the r17 discipline.
+
+    Part B — dying shadow lane: while a candidate stages on the shadow
+    mirror under live traffic, the shadow batcher is killed mid-staging.
+    Pins: every production request answers (the shadow has no wire path to
+    clients), zero sheds attributable to the shadow, and the verdict
+    degrades to a LOUD rollback (a lane that answered nothing can never be
+    promoted). Both faults are scheduled and consumed through a chaos
+    FaultPlan so the artifact proves they fired."""
+    import threading
+
+    import jax
+
+    from fedcrack_tpu.chaos.plan import (
+        REPLICA_CRASH_DURING_SCALE,
+        SHADOW_REPLICA_CRASH,
+        Fault,
+        FaultPlan,
+    )
+    from fedcrack_tpu.configs import ModelConfig, ServeConfig
+    from fedcrack_tpu.models.resunet import init_variables
+    from fedcrack_tpu.serve.autoscaler import FleetAutoscaler
+    from fedcrack_tpu.serve.fleet import ServeFleet
+    from fedcrack_tpu.serve.shadow import ShadowController
+
+    model_config = ModelConfig(
+        img_size=16, stem_features=4, encoder_features=(8,), decoder_features=(8, 4)
+    )
+    serve_config = ServeConfig(
+        bucket_sizes=(16,),
+        max_batch=4,
+        max_delay_ms=30.0,
+        tile_overlap=4,
+        replicas=3,
+        min_replicas=1,
+        max_replicas=3,
+        scale_cooldown_s=0.0,
+        scale_down_idle_evals=1,
+        shadow_fraction=0.5,
+        shadow_min_samples=64,
+    )
+    v0 = init_variables(jax.random.key(0), model_config)
+    v1 = init_variables(jax.random.key(1), model_config)
+    plan = FaultPlan(
+        [
+            Fault(kind=REPLICA_CRASH_DURING_SCALE, round=1),
+            Fault(kind=SHADOW_REPLICA_CRASH, round=0),
+        ]
+    )
+
+    class _SlowBatches:
+        """Stretch every dispatch so queued backlogs provably exist on the
+        drained/crashed replicas at race time (see run_replica_crash_drill)."""
+
+        def on_batch(self, bucket, batch_index, attempt):
+            time.sleep(0.05)
+
+    fleet = ServeFleet(
+        model_config, serve_config, v0, initial_version=0, chaos=_SlowBatches()
+    )
+    auto = FleetAutoscaler(fleet)
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, (16, 16, 3), dtype=np.uint8)
+    t_start = time.perf_counter()
+    # A calm synthetic exposition: the autoscaler sees 3 idle replicas and
+    # wants one drained — the drill controls WHEN, so the crash can race it.
+    calm = {
+        "serve_fleet_replicas": {
+            "type": "gauge", "help": "", "samples": {(): 3.0}
+        },
+        "serve_rolling_p95_seconds": {
+            "type": "gauge", "help": "", "samples": {(): 0.0}
+        },
+        "serve_router_queue_depth_total": {
+            "type": "gauge", "help": "",
+            "samples": {(("bucket", "16"),): 0.0},
+        },
+    }
+    try:
+        # ---- part A: crash vs scale-down race ----
+        n_burst = 24
+        futures = []
+        fut_lock = threading.Lock()
+
+        def submit_some(n):
+            for _ in range(n):
+                f = fleet.submit(img)
+                with fut_lock:
+                    futures.append(f)
+
+        threads = [
+            threading.Thread(target=submit_some, args=(n_burst // 4,))
+            for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        fault = plan.take(REPLICA_CRASH_DURING_SCALE, round=1)
+        assert fault is not None
+        crash_victim = fault.round  # replica index, like SERVE_REPLICA_CRASH
+        t_race = time.perf_counter()
+        barrier = threading.Barrier(2)
+
+        def scale_down():
+            barrier.wait()
+            auto.evaluate(calm)  # calm + idle_evals=1 -> drains replica 2
+
+        def crash():
+            barrier.wait()
+            fleet.router.kill_replica(crash_victim)
+
+        racers = [
+            threading.Thread(target=scale_down),
+            threading.Thread(target=crash),
+        ]
+        for t in racers:
+            t.start()
+        for t in racers:
+            t.join()
+        results = [f.result(timeout=60) for f in futures]
+        answered = len(results)
+        live_after = len(fleet.router.live_replicas())
+        scale_actions = [a["action"] for a in auto.actions]
+        race_s = round(time.perf_counter() - t_race, 3)
+
+        # ---- part B: dying shadow lane ----
+        ctrl = ShadowController(fleet)
+        stop_pump = threading.Event()
+        prod_results: list = []
+        prod_errors: list = []
+
+        def pump():
+            while not stop_pump.is_set():
+                try:
+                    prod_results.append(fleet.submit(img).result(timeout=30))
+                except Exception as e:  # any shed/fail here breaks the pin
+                    prod_errors.append(repr(e))
+
+        pump_threads = [threading.Thread(target=pump) for _ in range(2)]
+        for t in pump_threads:
+            t.start()
+
+        def kill_shadow():
+            # Wait for the mirror to attach, then kill its lane — the
+            # scheduled fault, consumed so the artifact proves it fired.
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                mirror = fleet.router._shadow
+                if mirror is not None and mirror.completed() >= 1:
+                    break
+                time.sleep(0.01)
+            fault_b = plan.take(SHADOW_REPLICA_CRASH, round=0)
+            assert fault_b is not None
+            mirror = fleet.router._shadow
+            if mirror is not None:
+                mirror._batcher.close()
+
+        killer = threading.Thread(target=kill_shadow)
+        killer.start()
+        verdict = ctrl.stage(1, v1, wait_s=4.0)
+        killer.join(timeout=15)
+        stop_pump.set()
+        for t in pump_threads:
+            t.join(timeout=15)
+        shed = sum(fleet.router.shed_counts().values())
+        return {
+            "burst": n_burst,
+            "fault_fired": [f.kind for f in plan.triggered],
+            "crash_victim": crash_victim,
+            "answered": answered,
+            "dropped": n_burst - answered,
+            "zero_dropped": answered == n_burst,
+            "live_after_race": live_after,
+            "scale_actions": scale_actions,
+            "shadow_verdict": verdict["verdict"],
+            "shadow_reasons": verdict["reasons"],
+            "shadow_completed": verdict["completed"],
+            "shadow_failures": verdict["shadow_failures"],
+            "production_answered_during_shadow": len(prod_results),
+            "production_errors_during_shadow": prod_errors,
+            "production_unperturbed": not prod_errors,
+            "shed_total": shed,
+            "rollback_not_promote": verdict["verdict"] == "rollback",
+            "race_s": race_s,
+            "drill_s": round(time.perf_counter() - t_start, 3),
+        }
+    finally:
+        fleet.close()
+
+
 def run_stream_reset_drill() -> dict:
     """SERVE_STREAM_RESET drill (round 19): a mid-stream session drop on
     the video serving plane.
@@ -1439,6 +1638,7 @@ def main(argv=None) -> int:
             "straggler_storm": run_straggler_storm_drill(),
             "buffered_kill": run_buffered_kill_drill(),
             "replica_crash": run_replica_crash_drill(),
+            "elastic_fleet": run_elastic_fleet_drill(),
             "scaled_update": run_scaled_update_drill(),
             "robust_aggregation": run_robust_aggregation_drill(),
             "stream_reset": run_stream_reset_drill(),

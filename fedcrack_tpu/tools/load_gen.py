@@ -35,6 +35,13 @@ the overflow instead of melting.
 N-th completion — a one-command serve-while-training smoke against a server
 watching that path.
 
+``--metrics-url`` (round 22) points at the server's Prometheus endpoint;
+a background sampler polls it through the run and the summary gains a
+``fleet`` block — ``serve_fleet_replicas`` min/max/first/last plus the
+full sample track — which is how the elastic-fleet smoke proves the
+autoscaler actually resized the fleet under the diurnal profile (the
+``replicas_varied`` flag) without reaching into server internals.
+
 Masks can be dumped as PNGs (``--out-dir``) and piped straight into
 ``tools/quantify.py --pred-dir`` — the reference's contour quantification
 over served output.
@@ -303,6 +310,86 @@ class _Collector:
                     }
                 )
             return out
+
+
+class _MetricsSampler:
+    """Poll a /metrics endpoint through a load run (round 22).
+
+    Samples ``serve_fleet_replicas`` (and the rolling p95 gauge when
+    present) every ``interval_s`` on a daemon thread. Scrape failures are
+    counted, never raised — a load run must not die because the metrics
+    port lagged. The summary's ``replicas_varied`` flag is the elastic
+    smoke's proof that the fleet actually resized mid-run."""
+
+    def __init__(self, url: str, interval_s: float = 0.5):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self.url = url
+        self.interval_s = interval_s
+        self.samples: list[dict] = []
+        self.errors = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._t0 = time.perf_counter()
+
+    def sample_once(self) -> None:
+        from fedcrack_tpu.obs.promexp import sample_value, scrape
+
+        try:
+            parsed = scrape(self.url, timeout_s=self.interval_s + 5.0)
+        except Exception:
+            with self._lock:
+                self.errors += 1
+            return
+        replicas = sample_value(parsed, "serve_fleet_replicas")
+        p95_s = sample_value(parsed, "serve_rolling_p95_seconds")
+        with self._lock:
+            self.samples.append(
+                {
+                    "t_s": round(time.perf_counter() - self._t0, 3),
+                    "replicas": int(replicas) if replicas is not None else None,
+                    "p95_ms": round(p95_s * 1e3, 3) if p95_s is not None else None,
+                }
+            )
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._t0 = time.perf_counter()
+
+        def loop():
+            self.sample_once()  # t=0 baseline before traffic lands
+            while not self._stop.wait(self.interval_s):
+                self.sample_once()
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=10)
+            self._thread = None
+        self.sample_once()  # final state after the run drained
+
+    def summary(self) -> dict:
+        with self._lock:
+            samples = list(self.samples)
+            errors = self.errors
+        track = [s["replicas"] for s in samples if s["replicas"] is not None]
+        return {
+            "url": self.url,
+            "interval_s": self.interval_s,
+            "samples": len(samples),
+            "scrape_errors": errors,
+            "replicas_min": min(track) if track else None,
+            "replicas_max": max(track) if track else None,
+            "replicas_first": track[0] if track else None,
+            "replicas_last": track[-1] if track else None,
+            "replicas_varied": bool(track) and min(track) != max(track),
+            "track": samples,
+        }
 
 
 def _stream_call(channel):
@@ -700,6 +787,8 @@ def run_load(
     video_size: int = 320,
     audit_every: int = 4,
     track: bool = False,
+    metrics_url: str | None = None,
+    metrics_interval_s: float = 0.5,
 ) -> dict:
     """Drive the endpoint; returns the JSON-safe summary (see module doc).
     ``on_complete()`` fires after every completed request — harnesses hook
@@ -718,8 +807,12 @@ def run_load(
 
     if mode not in ("closed", "open"):
         raise ValueError(f"mode must be 'closed' or 'open', got {mode!r}")
+    sampler = None
+    if metrics_url:
+        sampler = _MetricsSampler(metrics_url, metrics_interval_s)
+        sampler.start()
     if profile == "video":
-        return _run_video_load(
+        return _attach_fleet(sampler, _run_video_load(
             target,
             n_requests=n_requests,
             concurrency=concurrency,
@@ -739,7 +832,7 @@ def run_load(
             video_size=video_size,
             audit_every=audit_every,
             track=track,
-        )
+        ))
     if profile != "const" and mode != "open":
         raise ValueError(
             f"profile {profile!r} needs open-loop injection (--mode open); "
@@ -801,7 +894,7 @@ def run_load(
         shed = collector.shed
         per_size = dict(collector.per_size)
         versions = dict(collector.versions)
-    return {
+    return _attach_fleet(sampler, {
         "mode": mode,
         "target": target,
         "n_requests": n_requests,
@@ -821,7 +914,17 @@ def run_load(
         "latency_ms": collector.latency.summary(),
         "server_latency_ms": collector.server_latency.summary(),
         "masks": collector.masks if keep_masks else None,
-    }
+    })
+
+
+def _attach_fleet(sampler: _MetricsSampler | None, summary: dict) -> dict:
+    """Stop the metrics sampler (if any) and attach its ``fleet`` block."""
+    if sampler is not None:
+        sampler.stop()
+        summary["fleet"] = sampler.summary()
+    else:
+        summary["fleet"] = None
+    return summary
 
 
 def _run_video_load(
@@ -1025,6 +1128,17 @@ def main(argv=None) -> int:
         "--track", action="store_true",
         help="video profile: enable server-side crack-track continuity",
     )
+    p.add_argument(
+        "--metrics-url",
+        help="poll this Prometheus endpoint during the run and report the "
+        "serve_fleet_replicas track (min/max/varied) in the summary's "
+        "'fleet' block — the elastic-fleet smoke's proof the autoscaler "
+        "resized the fleet",
+    )
+    p.add_argument(
+        "--metrics-interval-s", type=float, default=0.5,
+        help="seconds between --metrics-url scrapes",
+    )
     p.add_argument("--sizes", default="128", help="comma-separated request sizes")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--threshold", type=float, default=0.5)
@@ -1103,6 +1217,8 @@ def main(argv=None) -> int:
         video_size=args.video_size,
         audit_every=args.audit_every,
         track=args.track,
+        metrics_url=args.metrics_url,
+        metrics_interval_s=args.metrics_interval_s,
     )
     masks = summary.pop("masks", None)
     if args.out_dir and masks:

@@ -832,6 +832,12 @@ def test_committed_lock_graph_artifact_is_current_and_acyclic():
         # Round 19: the video-session manager's cross-session accounting
         # lock (leaf — per-session state is single-handler by design).
         "fedcrack_tpu/serve/stream.py::StreamSessionManager._lock",
+        # Round 22: the elastic-fleet plane — autoscaler decision lock and
+        # the shadow lane's mirror/controller locks (all leaves; neither
+        # the scaler nor the shadow path holds a lock across fleet calls).
+        "fedcrack_tpu/serve/autoscaler.py::FleetAutoscaler._lock",
+        "fedcrack_tpu/serve/shadow.py::ShadowMirror._lock",
+        "fedcrack_tpu/serve/shadow.py::ShadowController._lock",
     }
 
 
@@ -1021,3 +1027,54 @@ def test_serve_plane_locks_recorded_under_monitor(stack_free_engine=None):
         assert isinstance(StaticWeights({}, 0).snapshot(), tuple)
     finally:
         san.uninstall_monitor()
+
+
+# ---- fleet plane (round 22) ----
+
+
+def test_fleet001_replica_set_mutation_outside_chokepoints():
+    """Replica-set surgery in serve/ must route through ServeFleet — a
+    convenience mutation desynchronizes the router's replica list from the
+    fleet manager's weights slots."""
+    append = "def grow(self):\n    self.router.replicas.append(object())\n"
+    assert "FLEET001" in rule_ids(lint(append, path="fedcrack_tpu/serve/router.py"))
+    delete = "def shrink(self):\n    del self.router.replicas[1]\n"
+    assert "FLEET001" in rule_ids(lint(delete, path="fedcrack_tpu/serve/front.py"))
+    slot = "def swap(self, r):\n    self.router.replicas[0] = r\n"
+    assert "FLEET001" in rule_ids(lint(slot, path="fedcrack_tpu/serve/router.py"))
+    # The lifecycle verbs ARE surgery wherever they're invoked in serve/.
+    verb = "def tick(self):\n    self.fleet.remove_replica(2)\n"
+    assert "FLEET001" in rule_ids(lint(verb, path="fedcrack_tpu/serve/shadow.py"))
+
+
+def test_fleet001_chokepoints_and_plain_assign_exempt():
+    # The fleet owns both lists; the autoscaler is the controller.
+    verb = "def tick(self):\n    self.fleet.remove_replica(2)\n"
+    assert "FLEET001" not in rule_ids(lint(verb, path="fedcrack_tpu/serve/fleet.py"))
+    assert "FLEET001" not in rule_ids(
+        lint(verb, path="fedcrack_tpu/serve/autoscaler.py")
+    )
+    # Constructing the initial list is legal everywhere — the router
+    # receives the list it routes over; it just may not reshape it.
+    assign = "def __init__(self, replicas):\n    self.replicas = list(replicas)\n"
+    assert "FLEET001" not in rule_ids(
+        lint(assign, path="fedcrack_tpu/serve/router.py")
+    )
+    # Outside serve/ (drills, benches driving kill_replica as the crash
+    # hook) is deliberately out of scope.
+    drill = "def crash(fleet):\n    fleet.router.kill_replica(1)\n"
+    assert "FLEET001" not in rule_ids(
+        lint(drill, path="fedcrack_tpu/tools/chaos_drill.py")
+    )
+
+
+def test_fleet001_own_serve_tree_is_clean():
+    """The shipped serving plane obeys its own rule."""
+    import glob
+
+    engine = LintEngine(rules=[rules_by_id()["FLEET001"]])
+    for path in sorted(glob.glob(os.path.join(REPO, "fedcrack_tpu", "serve", "*.py"))):
+        with open(path) as f:
+            src = f.read()
+        rel = os.path.relpath(path, REPO)
+        assert rule_ids(engine.lint_source(src, path=rel)) == [], rel

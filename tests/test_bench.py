@@ -868,3 +868,115 @@ def test_committed_r21_artifact_robust_aggregation_contract():
     health = ra["health_report"]
     assert health["schema_violations"] == [] and health["exclusion_visible"]
     assert set(coll["colluders"]) <= set(health["quarantined_clients"])
+
+
+def test_elastic_fleet_schema_guard():
+    """Round-22 elastic-fleet section: error-arm exempt, a present section
+    fully typed per arm (mistypes reported, never crashed), the shadow
+    block required, and the compact summary lists the section."""
+    bench = _import_bench()
+    arm = {
+        "replicas_band": [1, 3],
+        "completed": 120,
+        "shed": 0,
+        "dropped": 0,
+        "p95_ms": 233.1,
+        "wall_s": 8.8,
+        "replica_seconds": 13.9,
+        "replicas_min": 1,
+        "replicas_max": 3,
+        "replicas_varied": True,
+    }
+    good = {
+        "elastic_fleet": {
+            "profile": "diurnal",
+            "rate_rps": 24.0,
+            "requests": 120,
+            "slo_p95_ms": 1500.0,
+            "queue_bound": 10,
+            "arms": {
+                "static_max": dict(arm, replicas_band=[3, 3], replicas_varied=False),
+                "static_min": dict(arm, replicas_band=[1, 1], shed=8, replicas_varied=False),
+                "autoscaled": arm,
+            },
+            "autoscaler": {"scale_ups": 2, "scale_downs": 2},
+            "autoscaled_cheaper_than_static_max": True,
+            "autoscaled_held_slo": True,
+            "static_min_shed": True,
+            "shadow": {
+                "promote": {"verdict": "promote"},
+                "rollback": {"verdict": "rollback"},
+                "promoted": True,
+                "rolled_back": True,
+            },
+        }
+    }
+    assert bench.validate_detail(good) == []
+    assert bench.validate_detail({"elastic_fleet": {"error": "boom"}}) == []
+    assert any(
+        "elastic_fleet['shadow'] missing" in v
+        for v in bench.validate_detail(
+            {"elastic_fleet": {k: v for k, v in good["elastic_fleet"].items() if k != "shadow"}}
+        )
+    )
+    noarms = dict(good["elastic_fleet"], arms={})
+    assert any(
+        "elastic_fleet['arms'] is empty" in v
+        for v in bench.validate_detail({"elastic_fleet": noarms})
+    )
+    mistyped = dict(
+        good["elastic_fleet"],
+        arms=dict(good["elastic_fleet"]["arms"], autoscaled=dict(arm, shed="none")),
+    )
+    assert any(
+        "elastic_fleet.arms['autoscaled']['shed']" in v
+        for v in bench.validate_detail({"elastic_fleet": mistyped})
+    )
+    summary = bench.compact_summary({"detail": good})
+    assert "elastic_fleet" in summary["sections"]
+
+
+def test_committed_r22_artifact_elastic_fleet_contract():
+    """The round-22 acceptance pin: the committed CPU-smoke artifact ran
+    every section (skipped == []); the 3-arm diurnal A/B shows static-min
+    shedding at the peak while the autoscaled arm holds p95 under the SLO
+    with shed == 0 and dropped == 0 at STRICTLY lower replica-seconds than
+    static-max; the replica gauge provably varied mid-profile; and the
+    shadow lane promoted the good candidate and rolled back the degraded
+    one with the deciding deltas in the records."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "bench_runs", "r22_elastic_fleet_cpu_smoke.json")
+    with open(path) as f:
+        art = json.load(f)
+    assert art["detail"]["skipped"] == []
+    ef = art["detail"]["elastic_fleet"]
+    assert "error" not in ef
+    arms = ef["arms"]
+    assert {"static_max", "static_min", "autoscaled"} <= set(arms)
+    auto, smax, smin = arms["autoscaled"], arms["static_max"], arms["static_min"]
+    # Shed stays the loud backstop: the autoscaled arm never needed it.
+    assert auto["shed"] == 0 and auto["dropped"] == 0
+    assert auto["p95_ms"] <= ef["slo_p95_ms"]
+    assert ef["autoscaled_held_slo"] is True
+    # The whole point: SLO held at strictly lower replica-seconds.
+    assert auto["replica_seconds"] < smax["replica_seconds"]
+    assert ef["autoscaled_cheaper_than_static_max"] is True
+    # The under-provisioned control arm DID shed (and dropped nothing).
+    assert smin["shed"] > 0 and smin["dropped"] == 0
+    assert ef["static_min_shed"] is True
+    # Wire-level proof the fleet resized mid-profile, from the load_gen
+    # sampler polling serve_fleet_replicas over HTTP.
+    assert auto["replicas_varied"] is True
+    assert auto["replicas_max"] > auto["replicas_min"]
+    assert not smax["replicas_varied"] and not smin["replicas_varied"]
+    assert ef["autoscaler"]["scale_ups"] >= 1
+    # Progressive delivery: one promote, one rollback, deltas recorded.
+    shadow = ef["shadow"]
+    assert shadow["promoted"] is True and shadow["rolled_back"] is True
+    promote = shadow["promote"]
+    assert promote["verdict"] == "promote" and promote["installed"]
+    assert promote["iou"] >= promote["iou_floor"] and promote["reasons"] == []
+    rollback = shadow["rollback"]
+    assert rollback["verdict"] == "rollback" and not rollback["installed"]
+    assert rollback["reasons"] and rollback["iou"] < rollback["iou_floor"]
+    assert rollback["psi_max"] > rollback["psi_ceiling"]

@@ -1,0 +1,534 @@
+"""Elastic serve fleet (round 22): SLO-driven autoscaler + shadow-replica
+progressive delivery.
+
+The load-bearing claims, each pinned here:
+
+- the ``ServeConfig`` band validates as a unit: ``max_replicas`` without
+  ``min_replicas`` is a DISARMED ceiling and refuses to construct, and the
+  static ``replicas`` must sit inside an armed band;
+- the autoscaler consumes the registry's OWN Prometheus exposition (the
+  r15 parser over the r16 watchdog idiom) and takes at most one action per
+  evaluation: queue pressure scales up, cooldown blocks immediately after,
+  and only ``scale_down_idle_evals`` consecutive calm evaluations drain a
+  replica (hysteresis — a gust cannot flap the fleet);
+- ``ServeFleet.add_replica`` grows the fleet OFF the serving path: the new
+  replica's weights slot is committed before the router sees it, and a
+  fleet-wide install after a grow is still torn-version-free;
+- scale-down drains through the r17 ``kill_replica`` reroute: queued
+  requests on the drained replica complete on survivors with their
+  ORIGINAL futures — zero accepted requests drop;
+- shedding stays the loud backstop, not the steady state: a static fleet
+  against a tight queue bound sheds a paced burst, the SAME burst against
+  the SAME bound with the autoscaler armed completes shed-free because
+  capacity arrives first;
+- the shadow lane has NO wire path to clients: while a candidate with
+  different weights is staged under live traffic, every production answer
+  still carries the production version, and the router's replica set never
+  contains the shadow;
+- promote is the r17 two-phase commit (candidate == production → IoU 1.0,
+  PSI 0 → installed fleet-wide); a degraded candidate rolls back (IoU
+  cliff + PSI blowout, never installed, remembered so the poll loop will
+  not re-stage it);
+- the new chaos fault kinds are registered, and load_gen's --metrics-url
+  sampler reports a replica gauge that actually varied.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.serve
+
+TINY_KW = dict(
+    img_size=16, stem_features=4, encoder_features=(8,), decoder_features=(8, 4)
+)
+BUCKETS = (16,)
+
+
+def _serve_config(**over):
+    from fedcrack_tpu.configs import ServeConfig
+
+    kw = dict(
+        bucket_sizes=BUCKETS, max_batch=4, max_delay_ms=10.0, tile_overlap=4
+    )
+    kw.update(over)
+    return ServeConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def stack():
+    """One shared compiled engine and two weight versions — the bucket
+    compile dominates; every test takes fresh fleets over the same engine."""
+    import jax
+
+    from fedcrack_tpu.configs import ModelConfig
+    from fedcrack_tpu.models.resunet import init_variables
+    from fedcrack_tpu.serve import InferenceEngine
+
+    model_config = ModelConfig(**TINY_KW)
+    engine = InferenceEngine(model_config, _serve_config())
+    var0 = init_variables(jax.random.key(0), model_config)
+    var1 = init_variables(jax.random.key(1), model_config)
+    return model_config, engine, var0, var1
+
+
+def _fleet(stack, *, chaos=None, **cfg_over):
+    from fedcrack_tpu.serve import ServeFleet
+
+    model_config, engine, var0, _ = stack
+    cfg = _serve_config(**cfg_over)
+    return ServeFleet(
+        model_config, cfg, var0, shared_engine=engine, chaos=chaos, warmup=False
+    )
+
+
+def _img(size=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, (size, size, 3), dtype=np.uint8)
+
+
+class _SlowBatches:
+    """Stretch every dispatch so backlogs provably exist when a drain or a
+    shed race needs one (the r17 drill idiom)."""
+
+    def __init__(self, delay_s=0.05):
+        self.delay_s = delay_s
+
+    def on_batch(self, bucket, batch_index, attempt):
+        time.sleep(self.delay_s)
+
+
+def _parsed(live, p95_s, queued_by_bucket):
+    """A synthetic parse_prometheus_text result — the autoscaler's unit
+    harness (the production path parses the registry's own exposition)."""
+    return {
+        "serve_fleet_replicas": {
+            "type": "gauge", "help": "", "samples": {(): float(live)}
+        },
+        "serve_rolling_p95_seconds": {
+            "type": "gauge", "help": "", "samples": {(): float(p95_s)}
+        },
+        "serve_router_queue_depth_total": {
+            "type": "gauge",
+            "help": "",
+            "samples": {
+                (("bucket", str(b)),): float(n)
+                for b, n in queued_by_bucket.items()
+            },
+        },
+    }
+
+
+# ---- config band validation ----
+
+
+def test_serve_config_elastic_validation():
+    from fedcrack_tpu.configs import ServeConfig
+
+    _serve_config(replicas=2, min_replicas=1, max_replicas=4)
+    # max without min is a disarmed ceiling: loudly refused, never ignored.
+    with pytest.raises(ValueError):
+        _serve_config(max_replicas=4)
+    with pytest.raises(ValueError):
+        _serve_config(min_replicas=3, max_replicas=2, replicas=3)
+    # The static size must sit inside an armed band.
+    with pytest.raises(ValueError):
+        _serve_config(replicas=5, min_replicas=1, max_replicas=4)
+    with pytest.raises(ValueError):
+        _serve_config(min_replicas=-1)
+    for bad in (
+        dict(scale_interval_s=0.0),
+        dict(scale_cooldown_s=-1.0),
+        dict(scale_up_queue_depth=0),
+        dict(scale_up_p95_frac=0.0),
+        dict(scale_up_p95_frac=1.5),
+        dict(scale_down_idle_evals=0),
+        dict(shadow_fraction=-0.1),
+        dict(shadow_fraction=1.5),
+        dict(shadow_min_samples=0),
+        dict(shadow_iou_floor=0.0),
+        dict(shadow_iou_floor=1.5),
+        dict(shadow_psi_ceiling=0.0),
+        dict(shadow_latency_factor=0.5),
+    ):
+        with pytest.raises(ValueError):
+            _serve_config(**bad)
+    # Defaults stay disarmed: a pre-r22 config constructs unchanged.
+    assert ServeConfig().min_replicas == 0 and ServeConfig().shadow_fraction == 0.0
+
+
+def test_c18_preset_round_trips():
+    from fedcrack_tpu.configs import FedConfig
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "configs", "c18_elastic_fleet.json")) as f:
+        fed = FedConfig.from_json(f.read())
+    assert fed.serve.min_replicas == 1 and fed.serve.max_replicas == 6
+    assert fed.serve.min_replicas <= fed.serve.replicas <= fed.serve.max_replicas
+    assert 0.0 < fed.serve.shadow_fraction <= 1.0
+    assert FedConfig.from_json(fed.to_json()) == fed
+
+
+# ---- autoscaler control law ----
+
+
+def test_autoscaler_requires_armed_band(stack):
+    from fedcrack_tpu.serve import FleetAutoscaler
+
+    fleet = _fleet(stack, replicas=1)
+    try:
+        with pytest.raises(ValueError):
+            FleetAutoscaler(fleet)
+    finally:
+        fleet.close()
+
+
+def test_autoscaler_scale_up_cooldown_and_calm_scale_down(stack):
+    from fedcrack_tpu.serve import FleetAutoscaler
+    from fedcrack_tpu.serve.autoscaler import SCALE_DOWN, SCALE_UP
+
+    fleet = _fleet(
+        stack,
+        replicas=1,
+        min_replicas=1,
+        max_replicas=3,
+        scale_cooldown_s=10.0,
+        scale_up_queue_depth=4,
+        scale_down_idle_evals=3,
+        slo_p95_ms=200.0,
+    )
+    now = [1000.0]
+    auto = FleetAutoscaler(fleet, clock=lambda: now[0])
+    try:
+        pressure = _parsed(1, 0.0, {16: 5})
+        calm = _parsed(2, 0.0, {16: 0})
+
+        d = auto.evaluate(pressure)
+        assert d["action"] == SCALE_UP and d["replica"] == 1
+        assert len([r for r in fleet.router.replicas if r.alive]) == 2
+        # Cooldown: the identical pressure signal takes NO action.
+        now[0] += 1.0
+        assert auto.evaluate(pressure)["reason"] == "cooldown"
+        assert len([r for r in fleet.router.replicas if r.alive]) == 2
+        # p95 trigger (scale_up_p95_frac x SLO) fires without queue depth.
+        now[0] += 10.0
+        d = auto.evaluate(_parsed(2, 0.190, {16: 0}))
+        assert d["action"] == SCALE_UP and "p95" in d["reason"]
+        # Calm must hold for scale_down_idle_evals consecutive evaluations;
+        # one gust in between resets the counter (hysteresis).
+        now[0] += 10.0
+        live3 = _parsed(3, 0.0, {16: 0})
+        assert auto.evaluate(live3)["action"] is None
+        assert auto.evaluate(_parsed(3, 0.0, {16: 2}))["action"] is None  # gust
+        assert auto.evaluate(live3)["action"] is None
+        assert auto.evaluate(live3)["action"] is None
+        d = auto.evaluate(live3)
+        assert d["action"] == SCALE_DOWN
+        # The newest replica drains first; replica 0 never does.
+        assert d["replica"] == 2 and fleet.router.replicas[0].alive
+        audit = auto.audit()
+        assert audit["scale_ups"] == 2 and audit["scale_downs"] == 1
+        assert audit["replica_seconds"] > 0
+    finally:
+        auto.stop()
+        fleet.close()
+
+
+def test_autoscaler_at_max_never_grows(stack):
+    from fedcrack_tpu.serve import FleetAutoscaler
+
+    fleet = _fleet(stack, replicas=2, min_replicas=1, max_replicas=2)
+    auto = FleetAutoscaler(fleet, clock=lambda: 0.0)
+    try:
+        d = auto.evaluate(_parsed(2, 0.0, {16: 99}))
+        assert d["action"] is None and "at max_replicas" in d["reason"]
+        assert len(fleet.router.replicas) == 2
+    finally:
+        fleet.close()
+
+
+def test_autoscaler_reads_own_exposition(stack):
+    """The production signal path: refresh_gauges -> registry exposition ->
+    r15 parser -> the exact live/p95/queued triple."""
+    from fedcrack_tpu.serve import FleetAutoscaler
+
+    fleet = _fleet(stack, replicas=2, min_replicas=1, max_replicas=2)
+    auto = FleetAutoscaler(fleet)
+    try:
+        sig = auto.read_signals()
+        assert sig["live"] == 2 and sig["queued"] == 0
+        assert sig["p95_ms"] >= 0.0
+    finally:
+        fleet.close()
+
+
+# ---- fleet growth + drain ----
+
+
+def test_add_replica_commits_slot_before_router_and_swap_stays_zero_torn(stack):
+    _, _, _, var1 = stack
+    fleet = _fleet(stack, replicas=1)
+    try:
+        replica = fleet.add_replica(warm=False)
+        assert replica.index == 1 and len(fleet.router.replicas) == 2
+        # The weights slot committed with the grow: version matches prod.
+        v, payload = fleet.manager.snapshot_for(1)
+        assert v == 0 and payload is not None
+        results = [fleet.submit(_img()).result(timeout=60) for _ in range(8)]
+        assert {r.model_version for r in results} == {0}
+        # A fleet-wide install AFTER the grow covers the new replica too.
+        fleet.install(1, var1)
+        results = [fleet.submit(_img()).result(timeout=60) for _ in range(8)]
+        assert {r.model_version for r in results} == {1}
+    finally:
+        fleet.close()
+
+
+def test_scale_down_zero_accepted_drops(stack):
+    """The drain pin: a backlogged replica leaves through the kill_replica
+    reroute — every accepted future completes on a survivor."""
+    fleet = _fleet(stack, replicas=2, chaos=_SlowBatches(0.05))
+    try:
+        futures = [fleet.submit(_img(seed=i)) for i in range(16)]
+        reroute = fleet.remove_replica(1)
+        results = [f.result(timeout=60) for f in futures]
+        assert len(results) == 16  # zero drops, zero exceptions
+        assert sum(1 for r in fleet.router.replicas if r.alive) == 1
+        assert reroute["rerouted"] >= 0
+    finally:
+        fleet.close()
+
+
+def test_shed_is_backstop_static_sheds_autoscaled_does_not(stack):
+    """The diurnal pin, compressed: the same paced burst against the same
+    tight queue bound — the static single-replica fleet sheds loudly, the
+    autoscaled fleet grows first and completes everything."""
+    from fedcrack_tpu.serve import FleetAutoscaler
+    from fedcrack_tpu.serve.router import LoadShedError
+
+    def paced_burst(fleet, n=40, gap_s=0.01):
+        sheds, futures = 0, []
+        for i in range(n):
+            try:
+                futures.append(fleet.submit(_img(seed=i)))
+            except LoadShedError:
+                sheds += 1
+            time.sleep(gap_s)
+        results = [f.result(timeout=60) for f in futures]
+        return sheds, len(results)
+
+    static = _fleet(stack, replicas=1, queue_bound=6, chaos=_SlowBatches(0.06))
+    try:
+        static_sheds, static_done = paced_burst(static)
+    finally:
+        static.close()
+    assert static_sheds > 0  # the backstop fired, loudly
+    assert static_done == 40 - static_sheds  # and dropped nothing accepted
+
+    elastic = _fleet(
+        stack,
+        replicas=1,
+        min_replicas=1,
+        max_replicas=3,
+        queue_bound=6,
+        scale_interval_s=0.01,
+        scale_cooldown_s=0.05,
+        scale_up_queue_depth=2,
+        chaos=_SlowBatches(0.06),
+    )
+    auto = FleetAutoscaler(elastic)
+    auto.start()
+    try:
+        elastic_sheds, elastic_done = paced_burst(elastic)
+    finally:
+        auto.stop()
+        elastic.close()
+    assert elastic_sheds == 0 and elastic_done == 40
+    assert auto.audit()["scale_ups"] >= 1  # capacity arrived before the bound
+
+
+# ---- shadow delivery ----
+
+
+def test_shadow_isolation_no_wire_path_to_clients(stack):
+    """While a DIFFERENT-weights candidate is staged under live traffic,
+    every production answer still carries the production version, and the
+    shadow lane never appears in the router's replica set."""
+    from fedcrack_tpu.serve import ShadowController
+
+    _, _, _, var1 = stack
+    fleet = _fleet(stack, replicas=1, shadow_fraction=1.0, shadow_min_samples=2)
+    ctrl = ShadowController(fleet)
+    versions, errors = [], []
+    stop = threading.Event()
+
+    def pump():
+        i = 0
+        while not stop.is_set():
+            try:
+                versions.append(fleet.submit(_img(seed=i)).result(timeout=30).model_version)
+            except Exception as e:  # pragma: no cover - failure is the assert
+                errors.append(e)
+            i += 1
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    try:
+        record = ctrl.stage(7, var1, wait_s=10.0)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        fleet.close()
+    assert not errors
+    assert versions and set(versions) == {0}  # candidate never reached a client
+    assert len(fleet.router.replicas) == 1  # shadow is not a replica
+    assert fleet.router._shadow is None  # lane torn down with the verdict
+    assert record["completed"] >= 1  # mirrored traffic DID reach the shadow
+
+
+def test_shadow_promote_installs_fleet_wide(stack):
+    from fedcrack_tpu.serve import ShadowController
+
+    _, _, var0, _ = stack
+    fleet = _fleet(stack, replicas=2, shadow_fraction=1.0, shadow_min_samples=2)
+    ctrl = ShadowController(fleet)
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            fleet.submit(_img()).result(timeout=30)
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    try:
+        # A re-publish of the production weights: indistinguishable by
+        # construction — IoU 1.0, PSI 0 — the promote path.
+        record = ctrl.stage(1, var0, wait_s=10.0)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    try:
+        assert record["verdict"] == "promote" and record["installed"]
+        assert record["reasons"] == [] and record["iou"] == 1.0
+        assert fleet.manager.version == 1
+        res = fleet.submit(_img()).result(timeout=30)
+        assert res.model_version == 1
+        assert ctrl.audit()["promoted"] == 1
+    finally:
+        fleet.close()
+
+
+def test_shadow_rollback_never_installs_and_is_remembered(stack):
+    import jax
+
+    from fedcrack_tpu.serve import ShadowController
+
+    _, _, var0, _ = stack
+    fleet = _fleet(stack, replicas=1, shadow_fraction=1.0, shadow_min_samples=2)
+    ctrl = ShadowController(fleet)
+    v_bad = jax.tree_util.tree_map(lambda x: x * 0, var0)
+    record = ctrl.stage(5, v_bad, wait_s=0.2)
+    try:
+        assert record["verdict"] == "rollback" and not record["installed"]
+        # The deciding deltas are IN the record: IoU cliff + PSI blowout.
+        assert record["iou"] < ctrl.cfg.shadow_iou_floor
+        assert record["psi_max"] > ctrl.cfg.shadow_psi_ceiling
+        assert any("iou" in r for r in record["reasons"])
+        assert fleet.manager.version == 0  # production untouched
+        assert 5 in ctrl._rejected  # the poll loop will never re-stage it
+        assert ctrl.audit()["rolled_back"] == 1
+    finally:
+        fleet.close()
+
+
+def test_shadow_mirror_sampling_stride_and_failure_containment(stack):
+    from fedcrack_tpu.serve.batcher import MicroBatcher, StaticWeights
+    from fedcrack_tpu.serve.shadow import ShadowMirror
+
+    _, engine, var0, _ = stack
+    payload = engine.prepare(var0)
+    batcher = MicroBatcher(engine, StaticWeights(payload, 3))
+    mirror = ShadowMirror(batcher, 0.25)
+    assert mirror.stride == 4
+    try:
+        for i in range(8):
+            mirror.observe(_img(seed=i))
+        snap = mirror.snapshot()
+        assert snap["seen"] == 8 and snap["mirrored"] == 2
+    finally:
+        batcher.close()
+    # A dead shadow lane: observe swallows, failures counted, nothing raises.
+    dead = ShadowMirror(batcher, 1.0)
+    dead.observe(_img())
+    dead.observe(_img())
+    assert dead.snapshot()["failures"] == 2
+
+
+# ---- chaos kinds + satellites ----
+
+
+def test_elastic_chaos_kinds_registered():
+    from fedcrack_tpu.chaos.plan import (
+        ALL_KINDS,
+        FLEET_KINDS,
+        REPLICA_CRASH_DURING_SCALE,
+        SERVE_REPLICA_CRASH,
+        SHADOW_REPLICA_CRASH,
+        Fault,
+        FaultPlan,
+    )
+
+    assert {
+        SERVE_REPLICA_CRASH, REPLICA_CRASH_DURING_SCALE, SHADOW_REPLICA_CRASH
+    } <= FLEET_KINDS <= ALL_KINDS
+    plan = FaultPlan(
+        [
+            Fault(kind=REPLICA_CRASH_DURING_SCALE, round=1),
+            Fault(kind=SHADOW_REPLICA_CRASH, round=0),
+        ]
+    )
+    assert plan.take(REPLICA_CRASH_DURING_SCALE, round=1) is not None
+    assert plan.take(REPLICA_CRASH_DURING_SCALE, round=1) is None  # one-shot
+    with pytest.raises(ValueError):
+        Fault(kind="replica_crash_during_scalee", round=0)
+
+
+def test_metrics_sampler_reports_replica_variation():
+    from fedcrack_tpu.obs.promexp import MetricsExporter
+    from fedcrack_tpu.obs.registry import REGISTRY
+    from fedcrack_tpu.tools.load_gen import _MetricsSampler
+
+    gauge = REGISTRY.gauge("serve_fleet_replicas", "")
+    exporter = MetricsExporter(REGISTRY)
+    url = f"http://127.0.0.1:{exporter.start()}/metrics"
+    try:
+        sampler = _MetricsSampler(url, interval_s=0.05)
+        gauge.set(1)
+        sampler.sample_once()
+        gauge.set(3)
+        sampler.sample_once()
+        summary = sampler.summary()
+        assert summary["replicas_min"] == 1 and summary["replicas_max"] == 3
+        assert summary["replicas_varied"] and summary["scrape_errors"] == 0
+    finally:
+        exporter.stop()
+    with pytest.raises(ValueError):
+        _MetricsSampler(url, interval_s=0.0)
+
+
+def test_router_gauges_refresh_for_the_scraper(stack):
+    from fedcrack_tpu.obs.promexp import parse_prometheus_text
+    from fedcrack_tpu.obs.registry import REGISTRY
+
+    fleet = _fleet(stack, replicas=2)
+    try:
+        out = fleet.router.refresh_gauges()
+        assert out["p95_s"] >= 0.0 and out["queue_depth"].get(16) == 0
+        parsed = parse_prometheus_text(REGISTRY.exposition())
+        fam = parsed["serve_router_queue_depth_total"]
+        assert (("bucket", "16"),) in fam["samples"]
+    finally:
+        fleet.close()
