@@ -126,6 +126,10 @@ FEDCRACK_BENCH_LOWP=0 (skip the round-20 low-precision kernel A/B,
 detail.lowp_kernels) FEDCRACK_BENCH_LOWP_IMG=64 (its bucket size)
 FEDCRACK_BENCH_LOWP_CALLS=2 (predict calls at the short length; the long
 length is FIT_FACTOR x this)
+FEDCRACK_BENCH_PRIVACY=0 (skip the round-23 privacy section,
+detail.privacy) FEDCRACK_BENCH_PRIVACY_ROUNDS=2 (DP utility A/B rounds)
+FEDCRACK_BENCH_PRIVACY_SIGMAS=0.5,1.1 (noise multipliers beside the off
+arm)
 """
 
 from __future__ import annotations
@@ -189,6 +193,7 @@ DETAIL_SCHEMA: dict = {
     "robust_aggregation": dict,
     "video_serving": dict,
     "lowp_kernels": dict,
+    "privacy": dict,
 }
 # Typed keys of detail.observability (round 15): the concurrent mini-soak's
 # contract — the self-scrape must cover all five instrumented planes and
@@ -321,6 +326,47 @@ ROBUST_AGGREGATION_HEALTH_SCHEMA: dict = {
     "quarantines": int,
     "quarantined_clients": list,
     "exclusion_visible": bool,
+}
+# Typed keys of detail.privacy (round 23): the privacy plane's cost model —
+# the DP-SGD utility/epsilon trade at 2-3 noise levels on the mesh twin
+# (identical data/seeds, the only delta being the noise multiplier), the
+# secagg masking overhead vs the plaintext wire (host math: fixed-point
+# encode + pairwise pads, with the unmasked mean pinned EXACT against the
+# plaintext weighted sum), and the real-gRPC dropped-masker drill.
+PRIVACY_SCHEMA: dict = {
+    "rounds": int,
+    "dp_utility": dict,
+    "secagg_overhead": dict,
+    "secagg_drill": dict,
+    "bench_s": (int, float),
+}
+# Keys every arm of detail.privacy.dp_utility must carry. `epsilon` is
+# None only on the off arm (no noise, nothing to account).
+PRIVACY_DP_ARM_SCHEMA: dict = {
+    "noise_multiplier": (int, float),
+    "clip_norm": (int, float),
+    "epsilon": (int, float, type(None)),
+    "val_iou": (int, float),
+    "val_loss": (int, float),
+    "weight_drift_vs_off": (int, float),
+}
+PRIVACY_SECAGG_OVERHEAD_SCHEMA: dict = {
+    "n_params": int,
+    "cohort": int,
+    "bits": int,
+    "plaintext_bytes": int,
+    "masked_bytes": int,
+    "wire_ratio": (int, float),
+    "mask_ms": (int, float),
+    "unmask_ms": (int, float),
+    "exact_vs_plaintext": bool,
+}
+# The real-gRPC drill pins the section cannot ship without.
+PRIVACY_DRILL_SCHEMA: dict = {
+    "fault_fired": bool,
+    "dropout_recovered": bool,
+    "exact_average_bit_for_bit": bool,
+    "torn_rounds": int,
 }
 # Typed keys of detail.async_federation (round 14): the buffered-async
 # contract — the chaos straggler-storm sync-vs-buffered A/B at equal wall,
@@ -764,6 +810,56 @@ def validate_detail(detail: dict) -> list:
                         f"robust_aggregation.health_report[{key!r}]: "
                         f"{type(hp[key]).__name__}"
                     )
+    privacy = detail.get("privacy")
+    if isinstance(privacy, dict) and "error" not in privacy:
+        for key, typs in PRIVACY_SCHEMA.items():
+            if key not in privacy:
+                bad.append(f"privacy[{key!r}] missing")
+            elif not isinstance(privacy[key], typs):
+                bad.append(f"privacy[{key!r}]: {type(privacy[key]).__name__}")
+        dp_arms = privacy.get("dp_utility")
+        if isinstance(dp_arms, dict):
+            if not dp_arms:
+                bad.append("privacy['dp_utility'] is empty")
+            for arm_name in sorted(dp_arms):
+                arm = dp_arms[arm_name]
+                if not isinstance(arm, dict):
+                    bad.append(
+                        f"privacy.dp_utility[{arm_name!r}]: "
+                        f"{type(arm).__name__}"
+                    )
+                    continue
+                for key, typs in PRIVACY_DP_ARM_SCHEMA.items():
+                    if key not in arm:
+                        bad.append(
+                            f"privacy.dp_utility[{arm_name!r}]"
+                            f"[{key!r}] missing"
+                        )
+                    elif not isinstance(arm[key], typs):
+                        bad.append(
+                            f"privacy.dp_utility[{arm_name!r}]"
+                            f"[{key!r}]: {type(arm[key]).__name__}"
+                        )
+        overhead = privacy.get("secagg_overhead")
+        if isinstance(overhead, dict):
+            for key, typs in PRIVACY_SECAGG_OVERHEAD_SCHEMA.items():
+                if key not in overhead:
+                    bad.append(f"privacy.secagg_overhead[{key!r}] missing")
+                elif not isinstance(overhead[key], typs):
+                    bad.append(
+                        f"privacy.secagg_overhead[{key!r}]: "
+                        f"{type(overhead[key]).__name__}"
+                    )
+        drill = privacy.get("secagg_drill")
+        if isinstance(drill, dict):
+            for key, typs in PRIVACY_DRILL_SCHEMA.items():
+                if key not in drill:
+                    bad.append(f"privacy.secagg_drill[{key!r}] missing")
+                elif not isinstance(drill[key], typs):
+                    bad.append(
+                        f"privacy.secagg_drill[{key!r}]: "
+                        f"{type(drill[key]).__name__}"
+                    )
     cohort = detail.get("cohort_scale")
     if isinstance(cohort, dict) and "error" not in cohort:
         for key, typs in COHORT_SCALE_SCHEMA.items():
@@ -899,6 +995,22 @@ HEALTH = os.environ.get("FEDCRACK_BENCH_HEALTH", "1") == "1"
 # join over the quarantine arm's ledger. Host + tiny engine, seconds.
 # "0" opts out.
 ROBUST = os.environ.get("FEDCRACK_BENCH_ROBUST", "1") == "1"
+
+# Privacy section (round 23, detail.privacy): the DP-SGD utility/epsilon
+# trade on the mesh twin (off vs FEDCRACK_BENCH_PRIVACY_SIGMAS noise arms,
+# identical data/seeds), the secagg fixed-point masking overhead vs the
+# plaintext wire with an EXACT unmask pin, and the real-gRPC
+# dropped-masker drill. Tiny model; wall is the per-arm mesh compiles.
+# "0" opts out.
+PRIVACY = os.environ.get("FEDCRACK_BENCH_PRIVACY", "1") == "1"
+PRIVACY_ROUNDS = int(os.environ.get("FEDCRACK_BENCH_PRIVACY_ROUNDS", "2"))
+PRIVACY_SIGMAS = tuple(
+    float(s)
+    for s in os.environ.get(
+        "FEDCRACK_BENCH_PRIVACY_SIGMAS", "0.5,1.1"
+    ).split(",")
+    if s.strip()
+)
 
 # Low-precision kernel A/B (round 20, detail.lowp_kernels): the quantized
 # predict program per kernel plane — reference (the r17 dequantize-then-
@@ -3729,6 +3841,174 @@ def _bench_robust_aggregation() -> dict:
     return run_robust_aggregation_drill()
 
 
+def _bench_privacy() -> dict:
+    """detail.privacy (round 23): what the privacy plane COSTS.
+
+    1. DP utility A/B: the mesh DP-SGD twin at the off arm plus each
+       ``PRIVACY_SIGMAS`` noise multiplier — identical tiny model, data
+       and seeds, the noise multiplier the only delta — reporting val
+       IoU/loss, the final-weight drift off the noiseless trajectory, and
+       the accountant's closed-form eps(delta) per arm.
+    2. Secagg overhead: host-math masking microbench on a real-sized
+       update tree — fixed-point encode + pairwise pads per client timed
+       against the plaintext serialize, wire-size ratio, and the unmasked
+       weighted mean pinned EXACT against the plaintext fixed-point sum.
+    3. The real-gRPC dropped-masker drill (tools/chaos_drill): quorum
+       close, seed recovery, bit-for-bit survivor average, zero torn
+       rounds.
+    """
+    import jax
+
+    from fedcrack_tpu.configs import ModelConfig
+    from fedcrack_tpu.data.synthetic import synth_crack_batch
+    from fedcrack_tpu.fed.serialization import tree_to_bytes
+    from fedcrack_tpu.parallel import make_mesh, run_mesh_federation
+    from fedcrack_tpu.parallel.fedavg_mesh import (
+        build_federated_round,
+        stack_client_data,
+    )
+    from fedcrack_tpu.privacy import secagg as S
+    from fedcrack_tpu.privacy.accountant import compute_epsilon
+    from fedcrack_tpu.tools.chaos_drill import run_secagg_dropout_drill
+    from fedcrack_tpu.train.local import create_train_state, evaluate
+
+    t0 = time.monotonic()
+    tiny = ModelConfig(
+        img_size=16, stem_features=4, encoder_features=(8,),
+        decoder_features=(8, 4),
+    )
+    steps, batch = 2, 2
+    mesh1 = make_mesh(1, 1)
+    state0 = create_train_state(jax.random.key(0), tiny)
+    init = state0.variables
+
+    def data_fn(r: int):
+        images, masks = stack_client_data(
+            [synth_crack_batch(steps * batch, img_size=16, seed=r)],
+            steps,
+            batch,
+        )
+        return (
+            images,
+            masks,
+            np.ones(1, np.float32),
+            np.full(1, float(steps * batch), np.float32),
+        )
+
+    val_images, val_masks = synth_crack_batch(8, img_size=16, seed=977)
+
+    def run_arm(sigma: float):
+        rf = build_federated_round(
+            mesh1, tiny, learning_rate=1e-3, local_epochs=1,
+            dp_clip_norm=1.0 if sigma > 0.0 else 0.0,
+            dp_noise_multiplier=sigma, dp_seed=42,
+        )
+        v, _ = run_mesh_federation(rf, init, data_fn, PRIVACY_ROUNDS, mesh1)
+        metrics = evaluate(
+            state0.replace_variables(v), [(val_images, val_masks)]
+        )
+        return v, metrics
+
+    v_off, m_off = run_arm(0.0)
+    leaves_off = [np.asarray(x) for x in jax.tree_util.tree_leaves(v_off)]
+
+    def drift(v) -> float:
+        return float(
+            np.sqrt(
+                sum(
+                    float(np.sum((np.asarray(a) - b) ** 2))
+                    for a, b in zip(jax.tree_util.tree_leaves(v), leaves_off)
+                )
+            )
+        )
+
+    # One noise step per mesh round (local_epochs=1): eps after the run is
+    # the accountant's closed form at steps=PRIVACY_ROUNDS, the default
+    # FedConfig q/delta (0.01 / 1e-5) — the same numbers the server's
+    # history entries carry for this schedule.
+    dp_utility: dict = {
+        "off": {
+            "noise_multiplier": 0.0,
+            "clip_norm": 0.0,
+            "epsilon": None,
+            "val_iou": round(float(m_off["iou"]), 6),
+            "val_loss": round(float(m_off["loss"]), 6),
+            "weight_drift_vs_off": 0.0,
+        }
+    }
+    for sigma in PRIVACY_SIGMAS:
+        v_arm, m_arm = run_arm(float(sigma))
+        dp_utility[f"sigma_{sigma:g}"] = {
+            "noise_multiplier": float(sigma),
+            "clip_norm": 1.0,
+            "epsilon": round(
+                compute_epsilon(0.01, float(sigma), PRIVACY_ROUNDS, 1e-5), 6
+            ),
+            "val_iou": round(float(m_arm["iou"]), 6),
+            "val_loss": round(float(m_arm["loss"]), 6),
+            "weight_drift_vs_off": round(drift(v_arm), 6),
+        }
+
+    # ---- secagg masking overhead, host math on a real-sized tree ----
+    bits = S.DEFAULT_BITS
+    rng = np.random.Generator(np.random.Philox(key=7))
+    big_tree = {
+        "params": {
+            f"layer_{i}": rng.standard_normal(16384).astype(np.float32)
+            for i in range(4)
+        }
+    }
+    cohort = {name: S.client_seed(name) for name in ("a", "b", "c")}
+    roster = S.round_roster(cohort, 1)
+    plaintext_bytes = len(tree_to_bytes(big_tree))
+    t_mask = time.perf_counter()
+    masked = {
+        name: S.mask_update(
+            big_tree, cname=name, n_samples=10, roster=roster, bits=bits
+        )
+        for name in cohort
+    }
+    mask_ms = (time.perf_counter() - t_mask) / len(cohort) * 1e3
+    masked_bytes = max(len(b) for b in masked.values())
+    t_unmask = time.perf_counter()
+    uploads = {name: S.decode_masked(masked[name]) for name in masked}
+    total, total_samples, _dropped = S.unmask_sum(uploads, roster, bits)
+    mean = S.unmasked_mean(total, total_samples, big_tree, bits)
+    unmask_ms = (time.perf_counter() - t_unmask) * 1e3
+    expected = S.fixed_point_decode(
+        S.weighted_fixed_sum([big_tree] * 3, [10, 10, 10], bits),
+        30, bits, big_tree,
+    )
+    exact = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(mean),
+            jax.tree_util.tree_leaves(expected),
+        )
+    )
+    overhead = {
+        "n_params": int(
+            sum(np.asarray(x).size for x in jax.tree_util.tree_leaves(big_tree))
+        ),
+        "cohort": len(cohort),
+        "bits": int(bits),
+        "plaintext_bytes": plaintext_bytes,
+        "masked_bytes": int(masked_bytes),
+        "wire_ratio": round(masked_bytes / plaintext_bytes, 4),
+        "mask_ms": round(mask_ms, 3),
+        "unmask_ms": round(unmask_ms, 3),
+        "exact_vs_plaintext": bool(exact),
+    }
+
+    return {
+        "rounds": PRIVACY_ROUNDS,
+        "dp_utility": dp_utility,
+        "secagg_overhead": overhead,
+        "secagg_drill": run_secagg_dropout_drill(),
+        "bench_s": round(time.monotonic() - t0, 2),
+    }
+
+
 def main() -> None:
     # Smoke-test hook: this image pre-imports jax at interpreter startup with
     # the axon (real TPU tunnel) platform, so a JAX_PLATFORMS=cpu env override
@@ -4476,6 +4756,29 @@ def _run_sections(mesh, ref_mesh, n_clients, device, peak, skips, section_s) -> 
                 skips,
                 "robust_aggregation",
                 robust_est,
+                "estimate exceeds remaining budget",
+            )
+
+    # ---- privacy (round 23): the DP utility/epsilon A/B on the mesh
+    # twin (one compile per noise arm — that IS the wall), the secagg
+    # masking-overhead microbench with its exact unmask pin, and the
+    # real-gRPC dropped-masker drill ----
+    if PRIVACY:
+        privacy_est = (1 + len(PRIVACY_SIGMAS)) * COMPILE_EST_S + 15.0
+        if _fits(privacy_est):
+            t0 = time.monotonic()
+            try:
+                detail["privacy"] = _bench_privacy()
+            except Exception as e:  # a host-only extra must never kill the artifact
+                detail["privacy"] = {"error": repr(e)}
+            section_s["privacy"] = time.monotonic() - t0
+            detail["budget"] = _budget_detail()
+            _set_payload(metric_headline, value, vs_baseline, detail)
+        else:
+            _skip(
+                skips,
+                "privacy",
+                privacy_est,
                 "estimate exceeds remaining budget",
             )
 

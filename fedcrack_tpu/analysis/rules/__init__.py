@@ -23,6 +23,7 @@ def all_rules() -> list[Rule]:
         kernel_plane,
         locks,
         obs_plane,
+        privacy_plane,
         serve_plane,
         trace,
         transport,
@@ -32,7 +33,7 @@ def all_rules() -> list[Rule]:
     for pack in (
         determinism, durability, trace, transport, compress, async_plane,
         obs_plane, health_plane, agg_plane, locks, deadcode, serve_plane,
-        kernel_plane, fleet_plane,
+        kernel_plane, fleet_plane, privacy_plane,
     ):
         out.extend(cls() for cls in pack.RULES)
     return out

@@ -41,6 +41,7 @@ from fedcrack_tpu.chaos.plan import (
     MESH_NONFINITE,
     NAN_UPDATE,
     NETWORK_FLAP,
+    SECAGG_DROPOUT,
     SERVE_DEVICE_LOSS,
     SERVE_KINDS,
     SERVE_SWAP_MIDFLIGHT,
@@ -70,6 +71,7 @@ __all__ = [
     "MeshChaos",
     "NAN_UPDATE",
     "NETWORK_FLAP",
+    "SECAGG_DROPOUT",
     "SERVE_DEVICE_LOSS",
     "SERVE_KINDS",
     "SERVE_SWAP_MIDFLIGHT",

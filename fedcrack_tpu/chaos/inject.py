@@ -44,6 +44,7 @@ from fedcrack_tpu.chaos.plan import (
     NAN_UPDATE,
     NETWORK_FLAP,
     SCALED_UPDATE,
+    SECAGG_DROPOUT,
     SERVE_DEVICE_LOSS,
     SERVE_STREAM_RESET,
     SERVE_SWAP_MIDFLIGHT,
@@ -266,6 +267,13 @@ class ClientChaos:
             return
         if self.plan.take(CRASH_BEFORE_UPLOAD, client=cname, round=rnd) is not None:
             raise InjectedCrash(f"{cname}: crash before upload (round {rnd})")
+        if self.plan.take(SECAGG_DROPOUT, client=cname, round=rnd) is not None:
+            # Masker dropout (round 23): by this point the client's seed is
+            # in the frozen roster and every survivor masked against it —
+            # dying here forces the server's seed-recovery step.
+            raise InjectedCrash(
+                f"{cname}: secagg masker dropout (round {rnd})"
+            )
         fault = self.plan.take(STRAGGLER_DELAY, client=cname, round=rnd)
         if fault is not None:
             time.sleep(fault.delay_s)

@@ -41,6 +41,16 @@ CORRUPT_COMPRESSED_FRAME = "corrupt_compressed_frame"
 # averaged in; the health ledger's flush-time anomaly score is what flags
 # it (drilled by tools/chaos_drill.run_scaled_update_drill).
 SCALED_UPDATE = "scaled_update"
+# Secure-aggregation masker dropout (round 23, privacy plane): the client
+# dies in the exact window the Bonawitz recovery round exists for — AFTER
+# its masking seed entered the frozen roster (every survivor's upload
+# carries uncancelled pairwise masks against it) but BEFORE its own masked
+# upload. Mechanically a crash-before-upload, as its own kind so the
+# secagg drill schedules/asserts the privacy-plane scenario explicitly;
+# drilled by tools/chaos_drill.run_secagg_dropout_drill, which pins the
+# unmasked cohort average bit-for-bit against the survivors' plaintext
+# fixed-point sum after seed recovery.
+SECAGG_DROPOUT = "secagg_dropout"
 
 # Mesh plane (driver hook; fedcrack_tpu.parallel.driver fault_injector).
 MESH_DEVICE_FAIL = "mesh_device_fail"          # round dispatch raises (preemption)
@@ -115,6 +125,7 @@ CLIENT_KINDS = frozenset(
         STALE_REPLAY,
         CORRUPT_COMPRESSED_FRAME,
         SCALED_UPDATE,
+        SECAGG_DROPOUT,
     }
 )
 MESH_KINDS = frozenset({MESH_DEVICE_FAIL, MESH_NONFINITE})

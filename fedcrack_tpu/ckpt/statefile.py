@@ -101,6 +101,20 @@ def server_state_to_bytes(state: Any) -> bytes:
         # function of state, arrival order never leaks in. Absent in
         # pre-round-18 snapshots (restores as empty).
         "ledger": _health_ledger.ledger_to_wire(state.ledger),
+        # Privacy plane (round 23): the enroll-time secagg seeds, the
+        # frozen masking roster, and the DP accountant's per-client noise
+        # step counts (epsilon is recomputed from steps, never stored —
+        # the snapshot cannot disagree with the math). Sorted like every
+        # other map; absent in pre-round-23 snapshots (restore as empty).
+        "secagg_seeds": {
+            name: int(s) for name, s in sorted(state.secagg_seeds.items())
+        },
+        "secagg_roster": {
+            name: int(s) for name, s in sorted(state.secagg_roster.items())
+        },
+        "privacy_steps": {
+            name: int(t) for name, t in sorted(state.privacy_steps.items())
+        },
     }
     return msgpack.packb(payload, use_bin_type=True)
 
@@ -181,6 +195,15 @@ def server_state_from_bytes(blob: bytes, config: Any) -> Any:
             )
         ),
         ledger=_health_ledger.ledger_from_wire(payload.get("ledger", [])),
+        secagg_seeds={
+            k: int(v) for k, v in payload.get("secagg_seeds", {}).items()
+        },
+        secagg_roster={
+            k: int(v) for k, v in payload.get("secagg_roster", {}).items()
+        },
+        privacy_steps={
+            k: int(v) for k, v in payload.get("privacy_steps", {}).items()
+        },
         server_opt_state=opt_state,
         # Monotonic clocks do not survive a process: re-arm on first event
         # (rounds._advance_time stamps round_started_at when RUNNING).

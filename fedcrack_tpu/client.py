@@ -94,6 +94,29 @@ def main(argv: list[str] | None = None) -> int:
         help="gRPC send/receive cap in MiB (must cover the server's dense "
         "weight broadcast regardless of the negotiated upload codec)",
     )
+    p.add_argument(
+        "--dp-clip-norm",
+        type=float,
+        dest="dp_clip_norm",
+        help="update-level local DP (McMahan et al. 2018): clip this "
+        "round's (trained - base) delta to this L2 norm before upload "
+        "(0 disables)",
+    )
+    p.add_argument(
+        "--dp-noise-multiplier",
+        type=float,
+        dest="dp_noise_multiplier",
+        help="update-level DP noise: one seeded Gaussian N(0, "
+        "(sigma*clip)^2) draw added to the clipped delta; the seed is "
+        "derived from (dp_seed, name, round) so retried uploads are "
+        "bit-identical",
+    )
+    p.add_argument(
+        "--dp-seed",
+        type=int,
+        dest="dp_seed",
+        help="root seed of the per-(client, round) DP noise derivation",
+    )
     args = p.parse_args(argv)
 
     # Flags merge into the RAW config dict before FedConfig construction, so
@@ -121,6 +144,9 @@ def main(argv: list[str] | None = None) -> int:
             ("tls_cert", args.tls_cert),
             ("tls_key", args.tls_key),
             ("max_message_mb", args.max_message_mb),
+            ("dp_clip_norm", args.dp_clip_norm),
+            ("dp_noise_multiplier", args.dp_noise_multiplier),
+            ("dp_seed", args.dp_seed),
         ]
         if v is not None
     }
@@ -214,6 +240,32 @@ def main(argv: list[str] | None = None) -> int:
     train_fn, holder = make_train_fn(
         cfg, dataset, batch, seed=args.seed, metrics_logger=metrics_logger
     )
+    if cfg.dp_clip_norm > 0:
+        # Update-level local DP (privacy plane, round 23): clip + noise the
+        # round delta on the host before it ever reaches the wire — the
+        # server and other clients only see the privatized update. The
+        # noise key derives from (dp_seed, name, round), so a retried
+        # upload of the same round is bit-identical, never double-noised.
+        from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
+        from fedcrack_tpu.privacy.dpsgd import dp_update_host
+
+        inner_train_fn = train_fn
+
+        def train_fn(blob, rnd, *rest):
+            out_blob, n_samples, metrics = inner_train_fn(blob, rnd, *rest)
+            base = tree_from_bytes(blob)
+            trained = tree_from_bytes(out_blob, template=base)
+            private = dp_update_host(
+                trained,
+                base,
+                clip_norm=cfg.dp_clip_norm,
+                noise_multiplier=cfg.dp_noise_multiplier,
+                dp_seed=cfg.dp_seed,
+                cname=cname,
+                round_idx=rnd,
+            )
+            return tree_to_bytes(private), n_samples, metrics
+
     client = FedClient(cfg, train_fn, cname=cname)
     result = client.run_session()
     if metrics_logger is not None:

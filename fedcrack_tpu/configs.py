@@ -473,6 +473,46 @@ class FedConfig:
     # NOT_WAIT like a sanitation reject. 0 disables (detection without
     # response — r18 behavior). Composable with any `aggregation`.
     quarantine_z: float = 0.0
+    # ---- Privacy plane (round 23, fedcrack_tpu/privacy/) ----
+    # DP-SGD (Abadi et al. 2016): per-client gradient clipping to this L2
+    # norm inside the mesh plane's sgd_step (and, update-level, in the
+    # gRPC client CLI — McMahan et al. 2018). 0 disables DP entirely; the
+    # dp=off traced program is byte-identical to today's (test-pinned).
+    dp_clip_norm: float = 0.0
+    # Gaussian noise sigma, as a multiple of dp_clip_norm (noise stddev =
+    # dp_noise_multiplier * dp_clip_norm). Requires dp_clip_norm > 0 —
+    # unclipped noise has no sensitivity bound to calibrate against.
+    dp_noise_multiplier: float = 0.0
+    # Accountant parameters (privacy/accountant.py, the RDP/moments
+    # accountant): per-step sampling rate q, the delta of the reported
+    # eps(delta), and how many noise additions one round charges a client
+    # (0 derives local_epochs — the mesh plane's one-noise-per-epoch-step
+    # granularity collapses to epochs on the gRPC plane, where the server
+    # cannot see client step counts).
+    dp_sample_rate: float = 0.01
+    dp_delta: float = 1e-5
+    dp_steps_per_round: int = 0
+    # Root of the (client, round, step, leaf) noise seed tree — the r12
+    # codec-seed precedent, so chaos/retry replays are bit-identical.
+    dp_seed: int = 0
+    # eps(delta) budget: when any charged client's cumulative epsilon
+    # reaches this, the federation REFUSES to open further rounds and
+    # finishes (loud, recorded in history). 0 = unlimited.
+    dp_epsilon_budget: float = 0.0
+    # Pairwise-mask secure aggregation (round 23, privacy/secagg.py;
+    # Bonawitz et al. 2017): clients upload fixed-point int64 updates
+    # under pairwise PRG masks that cancel exactly in the ordered fold;
+    # dropout is closed by a seed-recovery step under the r8 quorum
+    # machinery. Masked updates are OPAQUE to the r18 ledger's norm/
+    # cosine windows, so secagg composes only with the null combine:
+    # aggregation must stay "fedavg", quarantine_z must stay 0, the
+    # update codec must stay "null", and mode must stay "sync" — each
+    # violation is a loud config error (the edge-tier-refuses-non-null
+    # precedent), documented as the privacy/robustness trade-off.
+    secagg: bool = False
+    # Fixed-point fractional bits for the masked encoding (values are
+    # round(x * 2^bits) in the 2^64 residue ring).
+    secagg_bits: int = 24
     # Mid-round durable server state (msgpack via atomic write+fsync+rename;
     # empty disables): persists cohort/phase/received blobs on every
     # membership or upload change, so a server killed MID-round resumes the
@@ -676,6 +716,81 @@ class FedConfig:
                 f"quarantine_z must be >= 0 (0 disables), got "
                 f"{self.quarantine_z}"
             )
+        if self.dp_clip_norm < 0.0:
+            raise ValueError(
+                f"dp_clip_norm must be >= 0 (0 disables DP), got "
+                f"{self.dp_clip_norm}"
+            )
+        if self.dp_noise_multiplier < 0.0:
+            raise ValueError(
+                f"dp_noise_multiplier must be >= 0, got "
+                f"{self.dp_noise_multiplier}"
+            )
+        if self.dp_noise_multiplier > 0.0 and self.dp_clip_norm <= 0.0:
+            raise ValueError(
+                "dp_noise_multiplier > 0 requires dp_clip_norm > 0: noise "
+                "is calibrated to the clip norm (stddev = multiplier * "
+                "clip), and unclipped gradients have no sensitivity bound "
+                "for the accountant to certify."
+            )
+        if not 0.0 < self.dp_sample_rate <= 1.0:
+            raise ValueError(
+                f"dp_sample_rate must be in (0, 1], got {self.dp_sample_rate}"
+            )
+        if not 0.0 < self.dp_delta < 1.0:
+            raise ValueError(
+                f"dp_delta must be in (0, 1), got {self.dp_delta}"
+            )
+        if self.dp_steps_per_round < 0:
+            raise ValueError(
+                f"dp_steps_per_round must be >= 0 (0 derives local_epochs), "
+                f"got {self.dp_steps_per_round}"
+            )
+        if self.dp_epsilon_budget < 0.0:
+            raise ValueError(
+                f"dp_epsilon_budget must be >= 0 (0 = unlimited), got "
+                f"{self.dp_epsilon_budget}"
+            )
+        if not 8 <= self.secagg_bits <= 52:
+            raise ValueError(
+                f"secagg_bits must be in [8, 52] (float64-exact fixed "
+                f"point), got {self.secagg_bits}"
+            )
+        if self.secagg:
+            # The privacy/robustness trade-off, stated loudly: masked
+            # uploads are uniformly-random residues, opaque to the r18
+            # ledger's norm/cosine windows and to every robust combine,
+            # and only the sync plane carries the roster handshake. Refuse
+            # the combination at config time (the edge-tier-refuses-
+            # non-null precedent) rather than silently degrade either
+            # property.
+            if self.aggregation != "fedavg":
+                raise ValueError(
+                    "secagg composes only with the null combine: masked "
+                    "updates are opaque to robust aggregation, so "
+                    "aggregation must be 'fedavg', got "
+                    f"{self.aggregation!r}. This is the privacy/robustness "
+                    "trade-off — pick one per federation."
+                )
+            if self.quarantine_z != 0.0:
+                raise ValueError(
+                    "secagg requires quarantine_z=0: the r18 ledger cannot "
+                    "window norms/cosines of masked uploads, so quarantine "
+                    "would act on noise. Got quarantine_z="
+                    f"{self.quarantine_z}."
+                )
+            if self.update_codec != "null":
+                raise ValueError(
+                    "secagg requires update_codec='null': the masked "
+                    "fixed-point wire format replaces the codec stack, got "
+                    f"{self.update_codec!r}"
+                )
+            if self.mode != "sync":
+                raise ValueError(
+                    "secagg requires mode='sync': the masking roster is a "
+                    "closed cohort, and the buffered plane folds across "
+                    f"cohort boundaries. Got mode={self.mode!r}."
+                )
         if self.wire_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"wire_dtype must be float32 or bfloat16, got {self.wire_dtype!r}"

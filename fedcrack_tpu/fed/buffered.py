@@ -453,6 +453,29 @@ class BufferedAggregator:
             # above keep their historical meaning (what the BUFFER held).
             "quarantined": quarantined,
         }
+        # DP accountant on the async plane (round 23): every buffered entry
+        # is ONE local training run whose noise is already in the blob, so
+        # each entry charges its sender ``dp_steps_per_round`` — including
+        # quarantined entries (the budget was SPENT client-side; exclusion
+        # from the fold refunds nothing). Mirrors rounds._aggregate: the
+        # epsilon map lands in the flush history entry and a breached
+        # budget finishes the federation loudly.
+        privacy_steps = state.privacy_steps
+        if state.config.dp_noise_multiplier > 0.0:
+            steps_per = (
+                state.config.dp_steps_per_round or state.config.local_epochs
+            )
+            privacy_steps = dict(privacy_steps)
+            for e in entries:
+                privacy_steps[e["cname"]] = (
+                    privacy_steps.get(e["cname"], 0) + int(steps_per)
+                )
+            epsilons = R._epsilons_for(state.config, privacy_steps)
+            entry["epsilon"] = epsilons
+            budget = state.config.dp_epsilon_budget
+            if budget > 0.0 and epsilons and max(epsilons.values()) >= budget:
+                entry["epsilon_budget_exhausted"] = True
+                finished = True
         # Retained-base window: the new broadcast joins, versions older
         # than max_staleness leave — the delta-decode memory bound.
         bases = {
@@ -463,6 +486,7 @@ class BufferedAggregator:
         bases[new_version] = new_wire_blob or new_blob
         return state._replace(
             ledger=new_ledger,
+            privacy_steps=privacy_steps,
             global_blob=new_blob,
             wire_blob=new_wire_blob,
             current_round=new_round,

@@ -71,6 +71,7 @@ Deliberate fixes over observed reference behavior (SURVEY.md §2.2):
 from __future__ import annotations
 
 import dataclasses
+import json
 import math
 from dataclasses import dataclass
 from typing import Any, Mapping
@@ -109,9 +110,15 @@ PHASE_FINISHED = "finished"
 # ---- events (client requests + time) ----
 @dataclass(frozen=True)
 class Ready:
-    """Registration request (reference 'R', fl_server.py:152-157)."""
+    """Registration request (reference 'R', fl_server.py:152-157).
+
+    ``secagg_seed`` (round 23): the client's per-session masking seed,
+    exchanged in-band at enroll like the codec handshake. None when the
+    client sent no seed — under secagg the server falls back to the
+    deterministic ``privacy.secagg.client_seed(cname)`` both ends derive."""
     cname: str
     now: float
+    secagg_seed: int | None = None
 
 
 @dataclass(frozen=True)
@@ -246,6 +253,18 @@ class ServerState:
     # scores), rolling and bounded per client. Persists in the statefile;
     # mutated only through the ledger module's pure helpers.
     ledger: Mapping[str, dict] = dataclasses.field(default_factory=dict)
+    # Privacy plane (round 23, fedcrack_tpu/privacy/). `secagg_seeds` holds
+    # every masking seed received at enroll; `secagg_roster` is the
+    # {name: seed} map FROZEN when the cohort closed (uploads are masked
+    # against it — a deadline shrink changes `cohort` but never the
+    # roster, the seed-recovery step covers the dropped maskers);
+    # `privacy_steps` is the RDP accountant's only state, per-client noise
+    # step counts (epsilon is recomputed from them, never stored). All
+    # three persist in the statefile so a mid-round kill-restart keeps
+    # masks recoverable and the privacy ledger monotone.
+    secagg_seeds: Mapping[str, int] = dataclasses.field(default_factory=dict)
+    secagg_roster: Mapping[str, int] = dataclasses.field(default_factory=dict)
+    privacy_steps: Mapping[str, int] = dataclasses.field(default_factory=dict)
 
     @property
     def broadcast_blob(self) -> bytes:
@@ -417,6 +436,13 @@ def _ready_config(state: ServerState, status: str) -> dict[str, Any]:
         # close; "buffered" clients loop pull→train→push continuously
         # (transport.client dispatches on this key).
         "mode": state.config.mode,
+        # Privacy plane (round 23): when on, the cohort must upload
+        # pairwise-masked fixed-point updates (transport.client fetches the
+        # frozen roster via TrainingNotice and masks with privacy.secagg).
+        # A legacy client that ignores the key uploads plaintext — which
+        # the secagg acceptance gate REJECTS (bad magic), never averages.
+        "secagg": state.config.secagg,
+        "secagg_bits": state.config.secagg_bits,
     }
 
 
@@ -441,6 +467,25 @@ def _barrier_met(state: ServerState) -> bool:
     )
 
 
+def _start_running(state: ServerState, now: float) -> ServerState:
+    """Close enrollment: phase -> RUNNING, and under secagg freeze the
+    masking roster to the closed cohort (enroll-received seed, or the
+    deterministic ``client_seed`` fallback both ends derive). Uploads are
+    masked and validated against THIS roster for the rest of the
+    federation — a deadline shrink drops members from ``cohort`` but their
+    masks are recovered from the roster, never renegotiated mid-round."""
+    state = state._replace(phase=PHASE_RUNNING, round_started_at=now)
+    if state.config.secagg:
+        from fedcrack_tpu.privacy.secagg import client_seed
+
+        roster = {
+            n: int(state.secagg_seeds.get(n, client_seed(n)))
+            for n in sorted(state.cohort)
+        }
+        state = state._replace(secagg_roster=roster)
+    return state
+
+
 def _advance_time(state: ServerState, now: float) -> ServerState:
     """Apply pure time effects: enrollment close, round deadline."""
     # A statefile-restored state carries no timestamps (the dead process's
@@ -463,7 +508,7 @@ def _advance_time(state: ServerState, now: float) -> ServerState:
         and now - state.enroll_opened_at >= state.config.registration_window_s
         and state.cohort
     ):
-        state = state._replace(phase=PHASE_RUNNING, round_started_at=now)
+        state = _start_running(state, now)
         # fast clients may have reported while enrollment was still open
         if _barrier_met(state):
             state = _aggregate(state, now)
@@ -539,41 +584,141 @@ def apply_fedopt(state: ServerState, avg: Any) -> tuple[Any, Any]:
     return avg, opt_state
 
 
+# Per-step RDP vectors are a pure function of (sigma, q, delta) and cost
+# ~10^4 log-space terms to evaluate — memoized process-wide so every round
+# of every server reuses them. Only immutable precomputes are read from the
+# cached accountant (never its steps dict), so concurrent servers sharing
+# the entry stay race-free.
+_ACCOUNTANT_MEMO: dict = {}
+
+
+def _epsilons_for(config: FedConfig, steps: Mapping[str, int]) -> dict[str, float]:
+    """Cumulative per-client eps(delta) for the given noise-step counts."""
+    from fedcrack_tpu.privacy.accountant import PrivacyAccountant, rdp_to_epsilon
+
+    key = (config.dp_noise_multiplier, config.dp_sample_rate, config.dp_delta)
+    acct = _ACCOUNTANT_MEMO.get(key)
+    if acct is None:
+        acct = PrivacyAccountant(
+            noise_multiplier=config.dp_noise_multiplier,
+            sample_rate=config.dp_sample_rate,
+            delta=config.dp_delta,
+        )
+        _ACCOUNTANT_MEMO[key] = acct
+    out = {}
+    for n in sorted(steps):
+        t = int(steps[n])
+        eps = (
+            rdp_to_epsilon(
+                [r * t for r in acct._rdp_step], acct.orders, acct.delta
+            )[0]
+            if t > 0
+            else 0.0
+        )
+        out[str(n)] = round(eps, 6)
+    return out
+
+
+def privacy_summary(state: ServerState) -> dict:
+    """The privacy-plane artifact block (server.py writes it beside the
+    metrics; tools/health_report.py joins it): DP accountant parameters +
+    cumulative per-client steps/epsilon, and the secagg mode/roster facts.
+    Deterministic — sorted clients, rounded epsilons."""
+    cfg = state.config
+    dp_on = cfg.dp_noise_multiplier > 0.0
+    eps = _epsilons_for(cfg, state.privacy_steps) if dp_on else {}
+    return {
+        "dp": {
+            "enabled": dp_on,
+            "clip_norm": float(cfg.dp_clip_norm),
+            "noise_multiplier": float(cfg.dp_noise_multiplier),
+            "sample_rate": float(cfg.dp_sample_rate),
+            "delta": float(cfg.dp_delta),
+            "epsilon_budget": float(cfg.dp_epsilon_budget),
+            "clients": {
+                n: {"steps": int(state.privacy_steps[n]), "epsilon": eps[n]}
+                for n in sorted(state.privacy_steps)
+            }
+            if dp_on
+            else {},
+            "max_epsilon": max(eps.values(), default=0.0) if dp_on else 0.0,
+        },
+        "secagg": {
+            "enabled": bool(cfg.secagg),
+            "bits": int(cfg.secagg_bits),
+            "roster_size": len(state.secagg_roster),
+        },
+    }
+
+
 def _aggregate(state: ServerState, now: float) -> ServerState:
     """Fold the round's received updates through the configured aggregation
     algebra (round 21, fed/aggregation.py; the FedAvg null instance is
     bitwise-pinned to the historical sorted fold), optionally + the FedOpt
-    server step; advance round/version."""
+    server step; advance round/version. Under secagg (round 23) the fold is
+    the modular unmask instead: sum the masked fixed-point residues in
+    sorted order, reconstruct+subtract every (survivor, dropped) pairwise
+    mask from the frozen roster's seeds, divide by the total sample count —
+    EXACT integer cancellation, pinned bit-for-bit against the plaintext
+    weighted fixed-point sum. Masked residues are opaque to the r18
+    ledger's geometry windows, so secagg skips observe_flush/quarantine
+    entirely (config validation already forced quarantine_z=0)."""
     names = sorted(state.received.keys())
-    # Decode against the float32 template so server math keeps full
-    # precision even when the wire carries bfloat16 payloads.
-    trees = [
-        tree_from_bytes(state.received[n][0], template=state.template)
-        for n in names
-    ]
     counts = [state.received[n][1] for n in names]
-    # Health ledger (round 18): score this flush's update geometry — norm
-    # and cosine-to-cohort-mean per client, robust z vs the window — on the
-    # SAME decoded trees the fold is about to combine (no second decode).
-    # Round 21 moved the scoring BEFORE the fold so the scores can GATE it:
-    # with quarantine_z > 0 a flagged client is excluded from the triples
-    # entirely (detection → response).
-    new_ledger, scores = _health_ledger.observe_flush(
-        state.ledger,
-        list(zip(names, trees)),
-        _decoded_round_base(state),
-    )
-    quarantined = _aggregation.quarantine_set(
-        scores, names, state.config.quarantine_z
-    )
-    for qname in quarantined:
-        new_ledger = _health_ledger.record_quarantine(new_ledger, qname)
-    triples = [
-        (n, c, t)
-        for n, c, t in zip(names, counts, trees)
-        if n not in quarantined
-    ]
-    avg = _aggregation.fold(_aggregation.from_config(state.config), triples)
+    secagg_info = None
+    if state.config.secagg:
+        from fedcrack_tpu.privacy.secagg import (
+            decode_masked,
+            round_roster,
+            unmask_sum,
+            unmasked_mean,
+        )
+
+        roster = round_roster(state.secagg_roster, state.current_round)
+        uploads = {n: decode_masked(state.received[n][0]) for n in names}
+        total, total_samples, dropped = unmask_sum(
+            uploads, roster, state.config.secagg_bits
+        )
+        avg = unmasked_mean(
+            total, total_samples, state.template, state.config.secagg_bits
+        )
+        new_ledger = state.ledger
+        quarantined: list[str] = []
+        secagg_info = {
+            "maskers": names,
+            "recovered": dropped,
+            "bits": int(state.config.secagg_bits),
+        }
+    else:
+        # Decode against the float32 template so server math keeps full
+        # precision even when the wire carries bfloat16 payloads.
+        trees = [
+            tree_from_bytes(state.received[n][0], template=state.template)
+            for n in names
+        ]
+        # Health ledger (round 18): score this flush's update geometry —
+        # norm and cosine-to-cohort-mean per client, robust z vs the
+        # window — on the SAME decoded trees the fold is about to combine
+        # (no second decode). Round 21 moved the scoring BEFORE the fold
+        # so the scores can GATE it: with quarantine_z > 0 a flagged
+        # client is excluded from the triples entirely
+        # (detection → response).
+        new_ledger, scores = _health_ledger.observe_flush(
+            state.ledger,
+            list(zip(names, trees)),
+            _decoded_round_base(state),
+        )
+        quarantined = _aggregation.quarantine_set(
+            scores, names, state.config.quarantine_z
+        )
+        for qname in quarantined:
+            new_ledger = _health_ledger.record_quarantine(new_ledger, qname)
+        triples = [
+            (n, c, t)
+            for n, c, t in zip(names, counts, trees)
+            if n not in quarantined
+        ]
+        avg = _aggregation.fold(_aggregation.from_config(state.config), triples)
     avg, opt_state = apply_fedopt(state, avg)
     new_blob = tree_to_bytes(avg)
     cast = _wire_cast(state.config)
@@ -612,8 +757,30 @@ def _aggregate(state: ServerState, now: float) -> ServerState:
         # this round), so exclusion is read from this map, not from them.
         "quarantined": quarantined,
     }
+    if secagg_info is not None:
+        # Secagg observability: who masked, which dropped maskers were
+        # closed by seed recovery, and the fixed-point precision.
+        entry["secagg"] = secagg_info
+    # DP accountant (round 23, privacy/accountant.py): charge this round's
+    # noise steps to every contributor and record the cumulative eps(delta)
+    # map in the history entry. When a budget is set and any client's
+    # epsilon reaches it, the federation REFUSES further rounds — privacy
+    # exhaustion finishes loudly, it never silently keeps spending.
+    privacy_steps = state.privacy_steps
+    if state.config.dp_noise_multiplier > 0.0:
+        steps_per = state.config.dp_steps_per_round or state.config.local_epochs
+        privacy_steps = dict(privacy_steps)
+        for n in names:
+            privacy_steps[n] = privacy_steps.get(n, 0) + int(steps_per)
+        epsilons = _epsilons_for(state.config, privacy_steps)
+        entry["epsilon"] = epsilons
+        budget = state.config.dp_epsilon_budget
+        if budget > 0.0 and epsilons and max(epsilons.values()) >= budget:
+            entry["epsilon_budget_exhausted"] = True
+            finished = True
     return state._replace(
         ledger=new_ledger,
+        privacy_steps=privacy_steps,
         global_blob=new_blob,
         wire_blob=new_wire_blob,
         current_round=new_round,
@@ -639,6 +806,15 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
             return state, Reply(status=state.phase)
 
         case Ready(cname=cname, now=now):
+            if state.config.secagg and event.secagg_seed is not None:
+                # Enroll-time seed exchange (round 23): remember the
+                # client's masking seed. Idempotent across re-enrolls; the
+                # roster snapshots at cohort close (_start_running).
+                state = state._replace(
+                    secagg_seeds={
+                        **state.secagg_seeds, cname: int(event.secagg_seed)
+                    }
+                )
             if state.phase == PHASE_FINISHED:
                 return state, Reply(status=FIN, config=_ready_config(state, FIN))
             if state.phase == PHASE_RUNNING:
@@ -686,7 +862,7 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
             # target cohort reached: close enrollment early (the reference
             # only had the fixed 10 s window, fl_server.py:40-52)
             if len(state.cohort) >= state.config.cohort_size:
-                state = state._replace(phase=PHASE_RUNNING, round_started_at=now)
+                state = _start_running(state, now)
             return state, Reply(status=SW, config=_ready_config(state, SW))
 
         case PullWeights(cname=cname):
@@ -706,7 +882,30 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
                 config=_ready_config(state, "OK"),
             )
 
-        case TrainingNotice():
+        case TrainingNotice(cname=cname):
+            if (
+                state.config.secagg
+                and state.phase == PHASE_RUNNING
+                and cname in state.cohort
+                and state.secagg_roster
+            ):
+                # Roster distribution (round 23): once the cohort closed,
+                # the TrainingNotice reply carries the frozen {name: seed}
+                # masking roster in-band (the __-prefixed side-channel
+                # precedent). A client whose notice lands while enrollment
+                # is still open gets no roster and retries before masking.
+                return state, Reply(
+                    status="OK",
+                    title="T",
+                    config={
+                        "__secagg_roster": json.dumps(
+                            {n: int(s)
+                             for n, s in sorted(state.secagg_roster.items())},
+                            sort_keys=True,
+                        ),
+                        "current_round": state.current_round,
+                    },
+                )
             return state, Reply(status="OK", title="T")
 
         case LogChunk(cname=cname, title=title, data=data, offset=offset):
@@ -840,14 +1039,43 @@ def transition(state: ServerState, event: Event) -> tuple[ServerState, Reply]:
             # step; an operator who needs multi-GB uploads sanitized
             # off-thread should gate at the transport instead. fedlint
             # COMP001 pins the frame decode to validate_update statically.
-            blob, wire_len, codec_name, problem, norm = decode_and_validate_update(
-                blob,
-                ns,
-                template=state.template,
-                base_fn=lambda: _decoded_round_base(state),
-                base_version=state.model_version,
-                sanitize=state.config.sanitize_updates,
-            )
+            if state.config.secagg:
+                # Secagg gate (round 23): a masked upload is uniformly-
+                # random residues — no norm/finiteness exists to check, so
+                # the gate is structural (magic, bits, the EXACT frozen
+                # roster, leaf shapes/dtypes) plus the sample-count pin
+                # between the event and the masked payload. The blob stays
+                # MASKED in `received`; only the cohort fold unmasks.
+                from fedcrack_tpu.privacy.secagg import (
+                    decode_masked,
+                    validate_masked,
+                )
+
+                wire_len = len(blob)
+                codec_name = "secagg"
+                norm = None
+                problem = validate_masked(
+                    blob,
+                    state.template,
+                    bits=state.config.secagg_bits,
+                    cohort=state.secagg_roster,
+                )
+                if problem is None:
+                    declared = int(decode_masked(blob)["n"])
+                    if declared != int(ns):
+                        problem = (
+                            f"masked sample count {declared} disagrees "
+                            f"with the declared {ns}"
+                        )
+            else:
+                blob, wire_len, codec_name, problem, norm = decode_and_validate_update(
+                    blob,
+                    ns,
+                    template=state.template,
+                    base_fn=lambda: _decoded_round_base(state),
+                    base_version=state.model_version,
+                    sanitize=state.config.sanitize_updates,
+                )
             if problem is not None:
                 # Refused BEFORE it can touch FedAvg; observable in the
                 # round's history entry. The client fails loudly — a

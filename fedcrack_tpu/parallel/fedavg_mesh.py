@@ -74,7 +74,7 @@ def _host_view(x) -> np.ndarray | None:
 
 def _epoch_runner(
     tx, apply_fn, inner_axis, n_inner, anchor, mu_arr, pw_arr,
-    weight_transform=None,
+    weight_transform=None, dp=None,
 ):
     """The per-client local-fit core, shared OP FOR OP by the monolithic
     round (``_build_round``) and the epoch-segmented variant
@@ -95,10 +95,25 @@ def _epoch_runner(
     anchor and FedAvg all keep operating on the float32 master weights.
     ``None`` leaves the traced program byte-identical to a pre-r20 build
     (the conditional is Python-level — the codec-twin discipline).
+
+    ``dp`` (round 23, the DP-SGD twin — fedcrack_tpu/privacy/dpsgd.py):
+    ``None`` leaves the program untouched (the same Python-level-
+    conditional discipline, test-pinned); otherwise a dict ``{"clip",
+    "sigma", "seed", "round_seed", "client_index"}`` turns on per-step
+    gradient clipping + seeded Gaussian noise right after the grads/
+    n_inner divide. The noise key chain is (dp_seed, round_seed, client,
+    step) — the round seed is the replicated per-dispatch scalar the int8
+    codec already threads (restored on chaos replay via ``codec_state``),
+    the step counter rides the scan carry (dp-on only).
     """
+    if dp is not None:
+        from fedcrack_tpu.privacy.dpsgd import dp_grad_transform, dp_step_key
 
     def sgd_step(carry, batch):
-        params, batch_stats, opt_state = carry
+        if dp is None:
+            params, batch_stats, opt_state = carry
+        else:
+            params, batch_stats, opt_state, dp_step = carry
         # Accept uint8 transport bytes (1/4 the staging traffic); the
         # on-device normalization reproduces float32 staging values
         # bit for bit (data.pipeline.as_model_batch).
@@ -135,6 +150,14 @@ def _epoch_runner(
         # test_dp_gradient_not_double_counted pins the current behavior.
         grads = psum_if_no_auto(grads, (inner_axis,))
         grads = jax.tree_util.tree_map(lambda g: g / n_inner, grads)
+        if dp is not None:
+            # DP-SGD (Abadi et al. 2016): clip the client's mean gradient
+            # to L2 norm C, then add N(0, (sigma*C)^2) noise keyed per
+            # (client, round, step, leaf) — replay-identical by seed chain.
+            key = dp_step_key(
+                dp["seed"], dp["round_seed"], dp["client_index"], dp_step
+            )
+            grads = dp_grad_transform(grads, key, dp["clip"], dp["sigma"])
         # BN moments are already pmean-synced inside the forward; this
         # keeps the carried stats bitwise identical across inner shards.
         new_stats = lax.pmean(new_stats, inner_axis)
@@ -146,7 +169,9 @@ def _epoch_runner(
             "iou_inter": lax.psum(m["iou_inter"], inner_axis),
             "iou_union": lax.psum(m["iou_union"], inner_axis),
         }
-        return (new_params, new_stats, new_opt_state), metrics
+        if dp is None:
+            return (new_params, new_stats, new_opt_state), metrics
+        return (new_params, new_stats, new_opt_state, dp_step + 1), metrics
 
     def epoch_reductions(step_metrics):
         return {
@@ -269,6 +294,9 @@ def _build_round(
     update_codec: str | None = None,
     topk_fraction: float = 0.01,
     lowp: str | None = None,
+    dp_clip_norm: float = 0.0,
+    dp_noise_multiplier: float = 0.0,
+    dp_seed: int = 0,
 ):
     """Shared core of the one-program federated round.
 
@@ -339,11 +367,35 @@ def _build_round(
         raise ValueError(
             f"lowp must be None, 'null' or 'fake_quant_int8', got {lowp!r}"
         )
+    # DP-SGD twin (round 23, privacy/dpsgd.py): per-step clip + seeded
+    # Gaussian noise inside sgd_step. Same null-build discipline as the
+    # codec and lowp twins — dp off (clip_norm == 0) leaves the traced
+    # program byte-identical (test-pinned); monolithic-only.
+    if dp_clip_norm < 0.0:
+        raise ValueError(f"dp_clip_norm must be >= 0, got {dp_clip_norm}")
+    if dp_noise_multiplier < 0.0:
+        raise ValueError(
+            f"dp_noise_multiplier must be >= 0, got {dp_noise_multiplier}"
+        )
+    dp_on = dp_clip_norm > 0.0
+    if dp_noise_multiplier > 0.0 and not dp_on:
+        raise ValueError(
+            "dp_noise_multiplier > 0 requires dp_clip_norm > 0 (noise is "
+            "calibrated to the clip norm)"
+        )
+    # The replicated per-dispatch seed scalar feeds int8's stochastic
+    # rounding AND the DP noise chain; either consumer pulls it in.
+    needs_seed = codec == "int8" or dp_on
+    # Normalised at build time: these are static Python config scalars and
+    # must stay host casts OUTSIDE the shard_map'd body (TRACE001).
+    dp_clip_f = float(dp_clip_norm)
+    dp_sigma_f = float(dp_noise_multiplier)
+    dp_seed_i = int(dp_seed)
 
-    # `extra` is the codec's side channel: the P('clients')-sharded
-    # error-feedback pytree for topk_delta, the replicated per-call seed
-    # scalar for int8's stochastic rounding, absent for null.
-    def client_fit(variables, data_a, data_b, active, n_samples, extra=None):
+    # `extras` is the side channel: the P('clients')-sharded error-feedback
+    # pytree for topk_delta (first), then the replicated per-call seed
+    # scalar (int8 stochastic rounding / DP round seed), absent for null.
+    def client_fit(variables, data_a, data_b, active, n_samples, *extras):
         # Per-shard blocks: leading clients-axis block is exactly one client.
         # Streamed: data_a/data_b are the [C, steps, B, ...] epoch slabs.
         # Resident: data_a is the (pool_images, pool_masks) pair, data_b the
@@ -355,6 +407,12 @@ def _build_round(
             chunk = (data_a[0], data_b[0])
             idx = None
         active_i, n_i = active[0], n_samples[0]
+        ei = 0
+        ef_extra = None
+        if topk:
+            ef_extra = extras[ei]
+            ei += 1
+        seed_in = extras[ei] if needs_seed else None
         params = variables["params"]
         batch_stats = variables["batch_stats"]
         anchor = params  # FedProx anchor = this round's global weights
@@ -362,21 +420,34 @@ def _build_round(
         mu_arr = jnp.asarray(mu, jnp.float32)
         pw_arr = jnp.asarray(pw, jnp.float32)
 
+        dp = None
+        if dp_on:
+            dp = {
+                "clip": dp_clip_f,
+                "sigma": dp_sigma_f,
+                "seed": dp_seed_i,
+                "round_seed": seed_in,
+                "client_index": lax.axis_index(CLIENTS),
+            }
         run_epochs = _epoch_runner(
             tx, apply_fn, inner_axis, n_inner, anchor, mu_arr, pw_arr,
-            weight_transform=weight_transform,
+            weight_transform=weight_transform, dp=dp,
         )
         # The carry becomes client-varying after the first data-dependent
         # update; promote the (replicated) initial carry so scan's carry type
-        # is stable under shard_map's varying-axes tracking.
+        # is stable under shard_map's varying-axes tracking. The dp-on carry
+        # also threads the per-step noise counter (Python-level: absent from
+        # the dp-off program).
+        carry0 = (params, batch_stats, opt_state)
+        if dp_on:
+            carry0 = carry0 + (jnp.uint32(0),)
         carry = jax.tree_util.tree_map(
-            lambda x: pcast_varying(x, (CLIENTS,)),
-            (params, batch_stats, opt_state),
+            lambda x: pcast_varying(x, (CLIENTS,)), carry0
         )
         carry, per_epoch = run_epochs(
             carry, [chunk], max(1, local_epochs), idx=idx
         )
-        params, batch_stats, _ = carry
+        params, batch_stats = carry[0], carry[1]
 
         ef_out = None
         if codec == "int8":
@@ -385,7 +456,7 @@ def _build_round(
             # Per-client stochastic-rounding stream: the replicated per-call
             # seed folded with this shard's client index.
             key = jax.random.fold_in(
-                jax.random.PRNGKey(extra), lax.axis_index(CLIENTS)
+                jax.random.PRNGKey(seed_in), lax.axis_index(CLIENTS)
             )
             update = _tree_add_cast(
                 base, int8_roundtrip(_tree_sub(update, base), key)
@@ -394,7 +465,7 @@ def _build_round(
         elif topk:
             update = {"params": params, "batch_stats": batch_stats}
             base = {"params": anchor, "batch_stats": variables["batch_stats"]}
-            ef_block = jax.tree_util.tree_map(lambda x: x[0], extra)
+            ef_block = jax.tree_util.tree_map(lambda x: x[0], ef_extra)
             kept, ef_new = topk_roundtrip(
                 _tree_sub(update, base), ef_block, topk_fraction
             )
@@ -443,31 +514,22 @@ def _build_round(
         )
     else:
         in_specs = (P(), image_spec, image_spec, P(CLIENTS), P(CLIENTS))
+    # Side-channel specs, in the extras order client_fit unpacks: the
+    # error-feedback accumulator rides through the program as a
+    # P('clients')-sharded pytree (in as this round's residual, out as the
+    # next round's — it never leaves device); one replicated uint32 seed
+    # per call feeds int8's stochastic rounding and/or the DP noise chain.
+    extra_specs: tuple = ()
     if topk:
-        # The error-feedback accumulator rides through the program as a
-        # P('clients')-sharded pytree: in as this round's residual, out as
-        # the next round's — it never leaves device.
-        sharded = shard_map(
-            client_fit,
-            mesh=mesh,
-            in_specs=in_specs + (P(CLIENTS),),
-            out_specs=(P(), P(CLIENTS), P(CLIENTS)),
-        )
-    elif codec == "int8":
-        # One replicated uint32 seed per call feeds the stochastic rounding.
-        sharded = shard_map(
-            client_fit,
-            mesh=mesh,
-            in_specs=in_specs + (P(),),
-            out_specs=(P(), P(CLIENTS)),
-        )
-    else:
-        sharded = shard_map(
-            client_fit,
-            mesh=mesh,
-            in_specs=in_specs,
-            out_specs=(P(), P(CLIENTS)),
-        )
+        extra_specs += (P(CLIENTS),)
+    if needs_seed:
+        extra_specs += (P(),)
+    sharded = shard_map(
+        client_fit,
+        mesh=mesh,
+        in_specs=in_specs + extra_specs,
+        out_specs=(P(), P(CLIENTS), P(CLIENTS)) if topk else (P(), P(CLIENTS)),
+    )
     jitted = jax.jit(sharded)
 
     def _wire_bytes_per_client(variables) -> int:
@@ -508,18 +570,21 @@ def _build_round(
         snapshot (parallel.driver does; the null twin carries no state)."""
         if round_fn.wire_bytes_per_client is None:
             round_fn.wire_bytes_per_client = _wire_bytes_per_client(variables)
-        if codec == "int8":
-            seed = jnp.uint32(ef_state["calls"])
-            out = jitted(variables, *data_args, seed)
+        extras = []
+        if topk:
+            if ef_state["ef"] is None:
+                ef_state["ef"] = _init_ef(variables)
+            extras.append(ef_state["ef"])
+        if needs_seed:
+            extras.append(jnp.uint32(ef_state["calls"]))
+        out = jitted(variables, *data_args, *extras)
+        if needs_seed:
             ef_state["calls"] += 1
-            return out
-        if not topk:
-            return jitted(variables, *data_args)
-        if ef_state["ef"] is None:
-            ef_state["ef"] = _init_ef(variables)
-        new_vars, metrics, ef_new = jitted(variables, *data_args, ef_state["ef"])
-        ef_state["ef"] = ef_new
-        return new_vars, metrics
+        if topk:
+            new_vars, metrics, ef_new = out
+            ef_state["ef"] = ef_new
+            return new_vars, metrics
+        return out
 
     if resident:
 
@@ -563,6 +628,11 @@ def _build_round(
     # Which low-precision training twin this round runs ("null" = the exact
     # pre-r20 program).
     round_fn.lowp = lowp
+    # Which DP twin this round runs ("null" = the exact pre-r23 program;
+    # "dpsgd" = per-step clip + seeded noise in sgd_step). The seed counter
+    # DP keys its rounds on is the codec_state "calls" field — replay
+    # restores it with the rest of the codec state.
+    round_fn.dp = "dpsgd" if dp_on else "null"
     round_fn.wire_bytes_per_client = None
     round_fn.reset_ef = lambda: ef_state.update(ef=None, calls=0)
     # Test hook: the device-resident EF pytree ([C, ...] per leaf), None
@@ -696,6 +766,9 @@ def build_federated_round(
     update_codec: str | None = None,
     topk_fraction: float = 0.01,
     lowp: str | None = None,
+    dp_clip_norm: float = 0.0,
+    dp_noise_multiplier: float = 0.0,
+    dp_seed: int = 0,
 ):
     """Compile-once round function over ``Mesh(('clients', 'batch'))``.
 
@@ -752,6 +825,18 @@ def build_federated_round(
     the float32 masters. Trajectory pinned within the r12 int8-mesh-twin
     IoU tolerance vs the reference round (tests/test_kernels.py).
     Monolithic-only, like the codec twin.
+
+    ``dp_clip_norm``/``dp_noise_multiplier``/``dp_seed`` (round 23, the
+    DP-SGD twin — ``fedcrack_tpu/privacy/dpsgd.py``): ``dp_clip_norm=0``
+    leaves the program untouched (byte-identical build, test-pinned, same
+    discipline as the codec twin); ``> 0`` clips each client's per-step
+    mean gradient to that L2 norm inside ``sgd_step`` and (when
+    ``dp_noise_multiplier > 0``) adds ``N(0, (multiplier*clip)^2)`` noise
+    keyed per (dp_seed, round, client, step, leaf). The round axis of the
+    key chain is the same replicated per-dispatch seed scalar the int8
+    codec threads, restored on driver replay via ``codec_state()`` — a
+    chaos-retried round reproduces bit-identical noise (test-pinned).
+    Monolithic-only, like the codec and lowp twins.
     """
     model_config = model_config or ModelConfig()
     _require_axes(mesh, CLIENTS, BATCH)
@@ -772,6 +857,9 @@ def build_federated_round(
         update_codec=update_codec,
         topk_fraction=topk_fraction,
         lowp=lowp,
+        dp_clip_norm=dp_clip_norm,
+        dp_noise_multiplier=dp_noise_multiplier,
+        dp_seed=dp_seed,
     )
 
 

@@ -1,0 +1,47 @@
+"""The privacy plane (round 23): the third trust layer.
+
+The r18 health plane answered "who is lying to the federation"
+(detection), r21's aggregation algebra answered "keep the liar's update
+out" (response). This package answers the opposite question — what can
+the FEDERATION learn about an honest client:
+
+- :mod:`fedcrack_tpu.privacy.dpsgd` — differentially-private training:
+  per-client gradient clipping plus seeded Gaussian noise, wired into the
+  mesh plane's ``sgd_step`` closure (Abadi et al. 2016) and, update-level,
+  into the gRPC client CLI (McMahan et al. 2018).
+- :mod:`fedcrack_tpu.privacy.accountant` — the RDP/moments accountant
+  that converts (noise multiplier, sampling rate, steps) into a
+  cumulative per-client ε(δ), recorded in round history and persisted in
+  the r8 statefile.
+- :mod:`fedcrack_tpu.privacy.secagg` — pairwise-mask secure aggregation
+  on the gRPC plane (Bonawitz et al. 2017): fixed-point int64 modular
+  encoding with pairwise PRG masks that cancel EXACTLY in the r21
+  ordered fold, and a seed-recovery step so a round still closes when a
+  masker drops out.
+
+Composition is deliberately restricted where the layers conflict: masked
+updates are opaque to the r18 ledger's norm/cosine windows, so secagg
+mode refuses robust combines and quarantine at config-validation time —
+the privacy/robustness trade-off is a loud error, not a silent downgrade.
+"""
+
+from fedcrack_tpu.privacy.accountant import (  # noqa: F401
+    DEFAULT_ORDERS,
+    PrivacyAccountant,
+    compute_epsilon,
+    rdp_subsampled_gaussian,
+    rdp_to_epsilon,
+)
+from fedcrack_tpu.privacy.secagg import (  # noqa: F401
+    SECAGG_MAGIC,
+    client_seed,
+    decode_masked,
+    fixed_point_encode,
+    is_masked_blob,
+    mask_update,
+    pair_mask,
+    round_roster,
+    unmask_sum,
+    unmasked_mean,
+    validate_masked,
+)

@@ -116,6 +116,79 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         "the ledger's alert line)",
     )
     p.add_argument(
+        "--secagg",
+        dest="secagg",
+        action="store_const",
+        const=True,
+        default=None,
+        help="pairwise-mask secure aggregation (privacy plane, round 23): "
+        "the cohort uploads fixed-point masked updates whose masks cancel "
+        "exactly in the fold; a dropped masker is recovered from its "
+        "enroll-time seed. Requires aggregation=fedavg, quarantine_z=0 "
+        "and update_codec=null (validated loudly)",
+    )
+    p.add_argument(
+        "--secagg-bits",
+        type=int,
+        dest="secagg_bits",
+        help="fixed-point fractional bits for masked uploads (default 24)",
+    )
+    p.add_argument(
+        "--dp-clip-norm",
+        type=float,
+        dest="dp_clip_norm",
+        help="DP-SGD per-step L2 clip norm C for the cohort's local fits "
+        "(0 disables the DP twin; required > 0 when noise is on)",
+    )
+    p.add_argument(
+        "--dp-noise-multiplier",
+        type=float,
+        dest="dp_noise_multiplier",
+        help="DP-SGD Gaussian noise multiplier sigma: per-step noise is "
+        "N(0, (sigma*C)^2); drives the RDP accountant's per-client "
+        "epsilon in round history",
+    )
+    p.add_argument(
+        "--dp-sample-rate",
+        type=float,
+        dest="dp_sample_rate",
+        help="accountant's per-step subsampling rate q (default 0.01)",
+    )
+    p.add_argument(
+        "--dp-delta",
+        type=float,
+        dest="dp_delta",
+        help="accountant's target delta (default 1e-5)",
+    )
+    p.add_argument(
+        "--dp-steps-per-round",
+        type=int,
+        dest="dp_steps_per_round",
+        help="noise steps the accountant charges each contributor per "
+        "round close (default 0 = local_epochs)",
+    )
+    p.add_argument(
+        "--dp-seed",
+        type=int,
+        dest="dp_seed",
+        help="root seed of the per-(client, round, leaf) DP noise key "
+        "chain (kept in the persisted config; clients pass their own "
+        "--dp-seed, which must match for a coherent replay story)",
+    )
+    p.add_argument(
+        "--dp-epsilon-budget",
+        type=float,
+        dest="dp_epsilon_budget",
+        help="refuse further rounds once any client's accounted epsilon "
+        "reaches this budget (0 = unlimited)",
+    )
+    p.add_argument(
+        "--privacy-summary",
+        dest="privacy_summary_path",
+        help="write the final privacy summary (per-client epsilon, secagg "
+        "roster facts) as JSON here at federation end",
+    )
+    p.add_argument(
         "--server-optimizer",
         dest="server_optimizer",
         help="FedOpt server update: avg (plain FedAvg), momentum/fedavgm, "
@@ -271,6 +344,15 @@ def build_config(argv: list[str] | None = None) -> tuple[FedConfig, Any]:
         ("trim_fraction", "trim_fraction"),
         ("byzantine_f", "byzantine_f"),
         ("quarantine_z", "quarantine_z"),
+        ("secagg", "secagg"),
+        ("secagg_bits", "secagg_bits"),
+        ("dp_clip_norm", "dp_clip_norm"),
+        ("dp_noise_multiplier", "dp_noise_multiplier"),
+        ("dp_sample_rate", "dp_sample_rate"),
+        ("dp_delta", "dp_delta"),
+        ("dp_steps_per_round", "dp_steps_per_round"),
+        ("dp_seed", "dp_seed"),
+        ("dp_epsilon_budget", "dp_epsilon_budget"),
         ("server_optimizer", "server_optimizer"),
         ("server_lr", "server_lr"),
         ("server_momentum", "server_momentum"),
@@ -384,6 +466,19 @@ def main(argv: list[str] | None = None) -> int:
         logging.info("server eval %s", entry)
     if metrics is not None:
         metrics.close()
+    if args.privacy_summary_path or cfg.dp_noise_multiplier > 0 or cfg.secagg:
+        from fedcrack_tpu.fed.rounds import privacy_summary
+
+        summary = privacy_summary(final)
+        logging.info("privacy summary: %s", summary)
+        if args.privacy_summary_path:
+            from fedcrack_tpu.ioutils import atomic_write_bytes
+
+            atomic_write_bytes(
+                args.privacy_summary_path,
+                json.dumps(summary, sort_keys=True, indent=2).encode("utf-8"),
+            )
+            logging.info("privacy summary -> %s", args.privacy_summary_path)
     logging.info(
         "federation finished: %d rounds, final cohort %s",
         len(final.history),

@@ -1616,6 +1616,192 @@ def run_robust_aggregation_drill() -> dict:
     }
 
 
+def run_secagg_dropout_drill() -> dict:
+    """SECAGG_DROPOUT drill (round 23, privacy plane): a masker dies in the
+    Bonawitz recovery window — AFTER its seed froze into the masking roster
+    (survivors' uploads carry uncancelled pairwise masks against it) and
+    BEFORE its own masked upload — over REAL gRPC. The round must still
+    close at quorum via seed recovery, and the unmasked cohort average must
+    equal the plaintext weighted fixed-point mean of the SURVIVORS
+    bit-for-bit: modular integer cancellation, not float-tolerance.
+
+    3 FedClient sessions, `c` injected with a chaos-plan SECAGG_DROPOUT
+    (consumed through the plan so the artifact proves the drop fired).
+    The survivors' trainers add known constants, so the expected average
+    is closed-form; the pin runs in the fixed-point residue domain AND on
+    the decoded float blob the survivors pulled as the new global.
+    """
+    import threading
+
+    from fedcrack_tpu.chaos.inject import ClientChaos, InjectedCrash
+    from fedcrack_tpu.chaos.plan import SECAGG_DROPOUT, Fault, FaultPlan
+    from fedcrack_tpu.privacy.secagg import (
+        fixed_point_decode,
+        weighted_fixed_sum,
+    )
+    from fedcrack_tpu.transport.client import FedClient
+    from fedcrack_tpu.transport.service import FedServer, ServerThread
+
+    def fake_train(inc: float, ns: int):
+        def train_fn(blob: bytes, rnd: int):
+            tree = tree_from_bytes(blob)
+            tree["params"]["w"] = tree["params"]["w"] + np.float32(inc)
+            return tree_to_bytes(tree), ns, {"loss": float(rnd)}
+
+        return train_fn
+
+    cfg = FedConfig(
+        max_rounds=1,
+        cohort_size=3,
+        registration_window_s=5.0,
+        round_deadline_s=2.0,
+        quorum_fraction=0.67,
+        poll_period_s=0.05,
+        secagg=True,
+        port=0,
+    )
+    plan = FaultPlan([Fault(kind=SECAGG_DROPOUT, round=1, client="c")])
+    server = FedServer(cfg, _vars(0.0), tick_period_s=0.05)
+    t0 = time.perf_counter()
+    errors: dict[str, BaseException] = {}
+    results: dict[str, object] = {}
+
+    def run(client: FedClient, name: str) -> None:
+        try:
+            results[name] = client.run_session()
+        except InjectedCrash as e:
+            errors[name] = e
+
+    with ServerThread(server) as st:
+        clients = {
+            "a": FedClient(cfg, fake_train(1.0, 10), cname="a", port=st.port),
+            "b": FedClient(cfg, fake_train(3.0, 30), cname="b", port=st.port),
+            "c": FedClient(
+                cfg,
+                fake_train(5.0, 20),
+                cname="c",
+                port=st.port,
+                chaos=ClientChaos(plan),
+            ),
+        }
+        threads = [
+            threading.Thread(target=run, args=(cl, n))
+            for n, cl in clients.items()
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        state = st.state
+
+    entry = state.history[0] if state.history else {}
+    secagg_info = entry.get("secagg") or {}
+    # The drill's pin: the unmasked fixed-point sum of the fold equals the
+    # PLAINTEXT weighted sum of the survivors — recover it from the global
+    # blob by re-encoding the closed-form expectation through the same
+    # fixed-point path (bit-for-bit on the decoded float leaves).
+    surv_updates = [_vars(1.0), _vars(3.0)]
+    surv_ns = [10, 30]
+    want = fixed_point_decode(
+        weighted_fixed_sum(surv_updates, surv_ns, cfg.secagg_bits),
+        sum(surv_ns),
+        cfg.secagg_bits,
+        _vars(0.0),
+    )
+    got = tree_from_bytes(state.global_blob)
+    exact = bool(
+        np.array_equal(got["params"]["w"], want["params"]["w"])
+    )
+    return {
+        "fault_fired": [f.kind for f in plan.triggered] == [SECAGG_DROPOUT],
+        "dropper_crashed": "c" in errors and "c" not in results,
+        "survivors_completed": all(
+            n in results and results[n].rounds_completed == 1
+            for n in ("a", "b")
+        ),
+        "round_closed": state.phase == R.PHASE_FINISHED
+        and len(state.history) == 1,
+        "maskers": secagg_info.get("maskers"),
+        "recovered": secagg_info.get("recovered"),
+        "dropout_recovered": secagg_info.get("recovered") == ["c"],
+        "exact_average_bit_for_bit": exact,
+        "torn_rounds": int(state.failed_rounds),
+        "drill_s": round(time.perf_counter() - t0, 4),
+    }
+
+
+def run_dp_replay_drill() -> dict:
+    """DP replay drill (round 23): a mesh round with the DP-SGD twin on
+    (clip + seeded Gaussian noise) is killed by an injected device failure
+    and retried under ``max_round_retries`` — the retried trajectory must
+    be BIT-IDENTICAL to an uninterrupted run. The noise key chain's round
+    axis is the same replicated per-dispatch seed scalar the r12 codec
+    threads, restored on replay via ``codec_state()``; this drill is the
+    proof that a chaos-retried DP round never double-draws its noise."""
+    import jax
+
+    from fedcrack_tpu.chaos.inject import MeshChaos
+    from fedcrack_tpu.chaos.plan import MESH_DEVICE_FAIL, Fault, FaultPlan
+    from fedcrack_tpu.configs import ModelConfig
+    from fedcrack_tpu.data.synthetic import synth_crack_batch
+    from fedcrack_tpu.parallel import make_mesh, run_mesh_federation
+    from fedcrack_tpu.parallel.fedavg_mesh import (
+        build_federated_round,
+        stack_client_data,
+    )
+    from fedcrack_tpu.train.local import create_train_state
+
+    tiny = ModelConfig(
+        img_size=16, stem_features=4, encoder_features=(8,),
+        decoder_features=(8, 4),
+    )
+    steps, batch = 2, 2
+    mesh = make_mesh(1, 1)
+    t0 = time.perf_counter()
+
+    def data_fn(r: int):
+        images, masks = stack_client_data(
+            [synth_crack_batch(steps * batch, img_size=16, seed=r)],
+            steps,
+            batch,
+        )
+        return (
+            images,
+            masks,
+            np.ones(1, np.float32),
+            np.full(1, float(steps * batch), np.float32),
+        )
+
+    def build():
+        return build_federated_round(
+            mesh, tiny, learning_rate=1e-3, local_epochs=1,
+            dp_clip_norm=1.0, dp_noise_multiplier=1.1, dp_seed=42,
+        )
+
+    init = create_train_state(jax.random.key(0), tiny).variables
+    v_clean, _ = run_mesh_federation(build(), init, data_fn, 2, mesh)
+
+    plan = FaultPlan([Fault(kind=MESH_DEVICE_FAIL, round=0)])
+    v_chaos, records = run_mesh_federation(
+        build(), init, data_fn, 2, mesh,
+        max_round_retries=1, fault_injector=MeshChaos(plan),
+    )
+    identical = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(v_clean),
+            jax.tree_util.tree_leaves(v_chaos),
+        )
+    )
+    return {
+        "fault_fired": not plan.pending and len(plan.triggered) == 1,
+        "retries_round_0": int(records[0].retries),
+        "replay_bit_identical": bool(identical),
+        "rounds": len(records),
+        "drill_s": round(time.perf_counter() - t0, 4),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--out", required=True)
@@ -1642,6 +1828,8 @@ def main(argv=None) -> int:
             "scaled_update": run_scaled_update_drill(),
             "robust_aggregation": run_robust_aggregation_drill(),
             "stream_reset": run_stream_reset_drill(),
+            "secagg_dropout": run_secagg_dropout_drill(),
+            "dp_replay": run_dp_replay_drill(),
         }
     except BaseException:
         flight.dump("chaos drill failed")

@@ -60,6 +60,28 @@ CANARY_EVAL_SCHEMA = {
     "probe_batch": int,
     "probe_seed": int,
 }
+# Round 23: the privacy-plane artifact (fed.rounds.privacy_summary, written
+# by `server.py --privacy-summary`) joined into the report — the budget the
+# federation SPENT belongs next to who spent it.
+PRIVACY_DP_SCHEMA = {
+    "enabled": bool,
+    "clip_norm": (int, float),
+    "noise_multiplier": (int, float),
+    "sample_rate": (int, float),
+    "delta": (int, float),
+    "epsilon_budget": (int, float),
+    "clients": dict,
+    "max_epsilon": (int, float),
+}
+PRIVACY_CLIENT_SCHEMA = {
+    "steps": int,
+    "epsilon": (int, float),
+}
+PRIVACY_SECAGG_SCHEMA = {
+    "enabled": bool,
+    "bits": int,
+    "roster_size": int,
+}
 SUMMARY_SCHEMA = {
     "clients": int,
     "offers": int,
@@ -78,10 +100,11 @@ def build_report(
     ledger_path: str,
     canary_path: str | None = None,
     drift_path: str | None = None,
+    privacy_path: str | None = None,
 ) -> dict:
     """The joined report (deterministic: sorted clients, no timestamps).
-    The canary/drift sections are None when their artifact is not given —
-    absence, not an empty-but-plausible block."""
+    The canary/drift/privacy sections are None when their artifact is not
+    given — absence, not an empty-but-plausible block."""
     ledger = read_ledger_jsonl(ledger_path)
     cons = conservation(ledger)
     clients = {}
@@ -131,6 +154,10 @@ def build_report(
             "signals": sorted({k.split("/", 1)[1] for k in psis}),
             "buckets": sorted({k.split("/", 1)[0] for k in psis}),
         }
+    privacy = None
+    if privacy_path:
+        with open(privacy_path, encoding="utf-8") as f:
+            privacy = json.load(f)
     return {
         "generated_by": "fedcrack_tpu.tools.health_report",
         "anomaly_alert": ANOMALY_ALERT,
@@ -138,6 +165,7 @@ def build_report(
         "summary": summary,
         "canary": canary,
         "drift": drift,
+        "privacy": privacy,
     }
 
 
@@ -145,6 +173,14 @@ def _typed(block: dict, schema: dict, where: str, bad: list) -> None:
     for key, typ in schema.items():
         if key not in block:
             bad.append(f"{where}[{key!r}] missing")
+        elif typ is bool:
+            # A declared-bool field wants a REAL bool (the privacy block's
+            # `enabled` flags) — ints masquerading as flags fail.
+            if not isinstance(block[key], bool):
+                bad.append(
+                    f"{where}[{key!r}] is {type(block[key]).__name__}, "
+                    "wants bool"
+                )
         elif isinstance(block[key], bool) or not isinstance(block[key], typ):
             bad.append(
                 f"{where}[{key!r}] is {type(block[key]).__name__}, wants {typ}"
@@ -198,6 +234,53 @@ def validate_report(report: dict) -> list:
                     math.isfinite(iou) and 0.0 <= iou <= 1.0
                 ):
                     bad.append(f"canary.history[{i}].iou not a unit value")
+    privacy = report.get("privacy")
+    if privacy is not None:
+        dp = privacy.get("dp") if isinstance(privacy, dict) else None
+        sa = privacy.get("secagg") if isinstance(privacy, dict) else None
+        if not isinstance(dp, dict):
+            bad.append("privacy.dp missing or not a dict")
+        else:
+            _typed(dp, PRIVACY_DP_SCHEMA, "privacy.dp", bad)
+            pclients = dp.get("clients")
+            if isinstance(pclients, dict):
+                for name in sorted(pclients):
+                    rec = pclients[name]
+                    where = f"privacy.dp.clients[{name!r}]"
+                    if not isinstance(rec, dict):
+                        bad.append(f"{where} not a dict")
+                        continue
+                    _typed(rec, PRIVACY_CLIENT_SCHEMA, where, bad)
+                    eps = rec.get("epsilon")
+                    if isinstance(eps, (int, float)) and not (
+                        math.isfinite(eps) and eps >= 0.0
+                    ):
+                        bad.append(f"{where}.epsilon not finite-nonnegative")
+                # The headline must AGREE with the per-client ledger: a
+                # max_epsilon that is not the max of its own rows is a
+                # privacy accounting bug, the one class this report exists
+                # to catch.
+                worst = max(
+                    (
+                        float(r.get("epsilon", 0.0))
+                        for r in pclients.values()
+                        if isinstance(r, dict)
+                        and isinstance(r.get("epsilon"), (int, float))
+                    ),
+                    default=0.0,
+                )
+                got = dp.get("max_epsilon")
+                if isinstance(got, (int, float)) and not math.isclose(
+                    float(got), worst, rel_tol=1e-9, abs_tol=1e-9
+                ):
+                    bad.append(
+                        f"privacy.dp.max_epsilon {got} != per-client max "
+                        f"{worst}"
+                    )
+        if not isinstance(sa, dict):
+            bad.append("privacy.secagg missing or not a dict")
+        else:
+            _typed(sa, PRIVACY_SECAGG_SCHEMA, "privacy.secagg", bad)
     drift = report.get("drift")
     if drift is not None:
         psis = drift.get("psi") if isinstance(drift, dict) else None
@@ -220,10 +303,15 @@ def main(argv=None) -> int:
     p.add_argument("--ledger", required=True, help="ledger JSONL path")
     p.add_argument("--canary", default="", help="canary history JSON path")
     p.add_argument("--drift", default="", help="drift profile JSON path")
+    p.add_argument(
+        "--privacy", default="",
+        help="privacy summary JSON path (server.py --privacy-summary)",
+    )
     p.add_argument("--out", default="", help="write the joined report here")
     args = p.parse_args(argv)
     report = build_report(
-        args.ledger, args.canary or None, args.drift or None
+        args.ledger, args.canary or None, args.drift or None,
+        args.privacy or None,
     )
     violations = validate_report(report)
     payload = json.dumps(report, indent=1, sort_keys=True)

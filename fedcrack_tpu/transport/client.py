@@ -252,7 +252,17 @@ class FedClient:
             # Phase 1: enroll (reference 'R', fl_client.py:84-96)
             msg = self._msg()
             msg.ready.SetInParent()
-            encode_scalar_map(msg.ready.config, {"current_round": 0})
+            enroll_cfg: dict[str, Any] = {"current_round": 0}
+            if self.config.secagg:
+                # Secure aggregation (round 23): ship the masking seed
+                # in-band at enroll. The seed is the deterministic
+                # name-derived one BOTH ends can compute, so a server
+                # that never saw this key (or a client that never sent
+                # it) still lands on the same roster entry.
+                from fedcrack_tpu.privacy.secagg import client_seed
+
+                enroll_cfg["__secagg_seed"] = client_seed(self.cname)
+            encode_scalar_map(msg.ready.config, enroll_cfg)
             with tracing.span("client.enroll", cname=self.cname):
                 rep = self._call(method, msg)
             cfg = decode_scalar_map(rep.config)
@@ -284,6 +294,13 @@ class FedClient:
                 topk_fraction=float(cfg.get("topk_fraction", 0.01) or 0.01),
                 client_tag=self.cname,
             )
+            # Secure aggregation (round 23): the SERVER's advertisement
+            # decides, like update_codec — a client launched without the
+            # flag still masks when the federation demands it (the roster
+            # entry falls back to the same name-derived seed both ends
+            # compute).
+            secagg_on = bool(cfg.get("secagg", False))
+            secagg_bits = int(cfg.get("secagg_bits", 24) or 24)
 
             if str(cfg.get("mode", "sync") or "sync") == "buffered":
                 # Async federation (round 14): the server runs FedBuff
@@ -314,7 +331,38 @@ class FedClient:
                 # Phase 3: announce training (reference 'T', fl_client.py:106-107)
                 msg = self._msg()
                 msg.training.round = current_round
-                self._call(method, msg)
+                rep_t = self._call(method, msg)
+                roster: dict[str, int] | None = None
+                if secagg_on:
+                    # The masking roster freezes at ENROLL->RUNNING; an
+                    # eager client can announce before the window closes,
+                    # so poll the notice until the reply carries it. The
+                    # roster is per-FEDERATION (frozen once); re-fetching
+                    # per round keeps the loop stateless across the
+                    # silent-cohort reopen, which re-freezes it.
+                    import json as _json
+
+                    deadline = time.monotonic() + self.retry_budget_s
+                    while True:
+                        tcfg = decode_scalar_map(rep_t.config)
+                        if "__secagg_roster" in tcfg:
+                            roster = {
+                                str(n): int(s)
+                                for n, s in _json.loads(
+                                    tcfg["__secagg_roster"]
+                                ).items()
+                            }
+                            break
+                        if time.monotonic() >= deadline:
+                            raise RuntimeError(
+                                "secagg masking roster never arrived: the "
+                                "round machine did not reach RUNNING within "
+                                f"{self.retry_budget_s:.0f}s"
+                            )
+                        time.sleep(self.poll_period_s)
+                        msg = self._msg()
+                        msg.training.round = current_round
+                        rep_t = self._call(method, msg)
 
                 # Phase 4: local fit (reference: manage_train, §3.3)
                 # `weights` at this point is the round BASE — the global
@@ -344,12 +392,32 @@ class FedClient:
                 # Phase 5: report (reference 'D', fl_client.py:124-127).
                 # The upload is the codec's encoding; local `weights` stay
                 # the full trained blob (the codec only shapes the wire).
-                upload = self.codec.encode_update(
-                    weights,
-                    round_base,
-                    round=current_round,
-                    base_version=model_version,
-                )
+                if secagg_on:
+                    # Pairwise-masked fixed-point upload (round 23): the
+                    # round index folds into every roster seed so no
+                    # one-time pad repeats across rounds. Config
+                    # validation pins update_codec="null" under secagg,
+                    # so no codec cross-round state exists to roll back.
+                    from fedcrack_tpu.fed.serialization import tree_from_bytes
+                    from fedcrack_tpu.privacy.secagg import (
+                        mask_update,
+                        round_roster,
+                    )
+
+                    upload = mask_update(
+                        tree_from_bytes(weights),
+                        cname=self.cname,
+                        n_samples=n_samples,
+                        roster=round_roster(roster, current_round),
+                        bits=secagg_bits,
+                    )
+                else:
+                    upload = self.codec.encode_update(
+                        weights,
+                        round_base,
+                        round=current_round,
+                        base_version=model_version,
+                    )
                 result.history.append(
                     {
                         "round": current_round,
@@ -377,14 +445,15 @@ class FedClient:
                     encode_scalar_map(
                         msg.done.metrics, {"__trace": push_ctx.to_wire()}
                     )
-                self._count_wire("up", len(upload), self.codec.name)
+                wire_codec = "secagg" if secagg_on else self.codec.name
+                self._count_wire("up", len(upload), wire_codec)
                 with tracing.span(
                     "client.push",
                     trace=trace,
                     parent=train_span.span_id if train_span else None,
                     cname=self.cname,
                     upload_bytes=len(upload),
-                    codec=self.codec.name,
+                    codec=wire_codec,
                     ctx=push_ctx.to_wire(),
                 ):
                     rep = self._call(method, msg)

@@ -45,7 +45,17 @@ def event_from_message(msg: pb.ClientMessage, now: float) -> R.Event:
     kind = msg.WhichOneof("msg")
     cname = msg.cname
     if kind == "ready":
-        return R.Ready(cname=cname, now=now)
+        # In-band secagg seed exchange (round 23): the client's masking
+        # seed rides the enroll config under "__secagg_seed". Anything
+        # that is not an int degrades to "no seed" — the server then
+        # falls back to the deterministic name-derived seed, so a
+        # malformed scalar can never strand an enrollment.
+        secagg_seed = None
+        if "__secagg_seed" in msg.ready.config:
+            scalar = msg.ready.config["__secagg_seed"]
+            if scalar.WhichOneof("value") == "as_int":
+                secagg_seed = int(scalar.as_int)
+        return R.Ready(cname=cname, now=now, secagg_seed=secagg_seed)
     if kind == "pull":
         return R.PullWeights(cname=cname, now=now)
     if kind == "training":

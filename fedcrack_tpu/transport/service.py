@@ -422,6 +422,14 @@ class FedServer:
             # snapshots there).
             tuple(sorted((e["cname"], e["seq"]) for e in state.buffer)),
             tuple(sorted(state.pulled.items())),
+            # Privacy plane (round 23): the enroll-time secagg seeds, the
+            # frozen masking roster, and the DP accountant's step counts.
+            # Seeds usually land with a cohort change, but a re-sent seed
+            # alone must still snapshot — the unmask step after a restart
+            # reconstructs masks from exactly these.
+            tuple(sorted(state.secagg_seeds.items())),
+            tuple(sorted(state.secagg_roster.items())),
+            tuple(sorted(state.privacy_steps.items())),
         )
 
     async def _apply(self, event: R.Event) -> R.Reply:

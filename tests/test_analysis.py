@@ -698,6 +698,55 @@ def test_kern001_fires_per_site_and_ignores_non_calls():
     assert "KERN001" not in rule_ids(lint(quiet, path="fedcrack_tpu/ops/fx.py"))
 
 
+# ---- privacy-plane pack ----
+
+
+def test_priv001_unseeded_rng_in_privacy_plane():
+    """PRIV001 (round 23): inside privacy/ every draw must trace to an
+    explicit seed — an argless generator constructor or an ambient entropy
+    source silently breaks mask recovery and DP-noise replay."""
+    path = "fedcrack_tpu/privacy/fixture.py"
+    # Argless construction pulls OS entropy even though it LOOKS like the
+    # seeded idiom.
+    assert "PRIV001" in rule_ids(
+        lint("import numpy as np\ng = np.random.default_rng()\n", path=path)
+    )
+    assert "PRIV001" in rule_ids(
+        lint("import numpy as np\nbg = np.random.Philox()\n", path=path)
+    )
+    assert "PRIV001" in rule_ids(
+        lint("import random\nr = random.Random()\n", path=path)
+    )
+    # Entropy-by-design sources are never acceptable, seeded or not.
+    for src in (
+        "import os\nseed = os.urandom(16)\n",
+        "import secrets\nseed = secrets.randbits(64)\n",
+        "import uuid\nseed = uuid.uuid4().int\n",
+    ):
+        assert "PRIV001" in rule_ids(lint(src, path=path))
+    # The shipped idiom — sha256-rooted explicit seeds into Philox — is
+    # clean (this is exactly what secagg.pair_mask / dpsgd do).
+    good = (
+        "import numpy as np\n"
+        "gen = np.random.Generator(np.random.Philox(key=int(seed)))\n"
+        "g2 = np.random.default_rng(42)\n"
+        "ss = np.random.SeedSequence(1234)\n"
+    )
+    assert "PRIV001" not in rule_ids(lint(good, path=path))
+    # Scoped: the same ambient draw outside privacy/ is DET-territory, not
+    # PRIV001's.
+    assert "PRIV001" not in rule_ids(
+        lint("import os\nseed = os.urandom(16)\n",
+             path="fedcrack_tpu/fed/rounds.py")
+    )
+    # The live privacy package itself must be clean under the rule.
+    engine = LintEngine(rules=[rules_by_id()["PRIV001"]])
+    modules = engine.load_modules(
+        [os.path.join(REPO, "fedcrack_tpu", "privacy")], rel_to=REPO
+    )
+    assert engine.lint_modules(modules) == []
+
+
 # ---- suppressions ----
 
 

@@ -980,3 +980,118 @@ def test_committed_r22_artifact_elastic_fleet_contract():
     assert rollback["verdict"] == "rollback" and not rollback["installed"]
     assert rollback["reasons"] and rollback["iou"] < rollback["iou_floor"]
     assert rollback["psi_max"] > rollback["psi_ceiling"]
+
+
+def test_privacy_schema_guard():
+    """Round-23 privacy section: error-arm exempt, a present section fully
+    typed (dp arms, secagg overhead, drill — mistypes reported, never
+    crashed), the off arm's epsilon allowed to be None, and the compact
+    summary lists the section."""
+    bench = _import_bench()
+    arm = {
+        "noise_multiplier": 1.1,
+        "clip_norm": 1.0,
+        "epsilon": 1.129401,
+        "val_iou": 0.18,
+        "val_loss": 0.7,
+        "weight_drift_vs_off": 0.17,
+    }
+    good = {
+        "privacy": {
+            "rounds": 2,
+            "dp_utility": {
+                "off": dict(arm, noise_multiplier=0.0, clip_norm=0.0,
+                            epsilon=None, weight_drift_vs_off=0.0),
+                "sigma_1.1": arm,
+            },
+            "secagg_overhead": {
+                "n_params": 65536,
+                "cohort": 3,
+                "bits": 24,
+                "plaintext_bytes": 262281,
+                "masked_bytes": 524416,
+                "wire_ratio": 2.0,
+                "mask_ms": 1.7,
+                "unmask_ms": 1.1,
+                "exact_vs_plaintext": True,
+            },
+            "secagg_drill": {
+                "fault_fired": True,
+                "dropout_recovered": True,
+                "exact_average_bit_for_bit": True,
+                "torn_rounds": 0,
+            },
+            "bench_s": 69.0,
+        }
+    }
+    assert bench.validate_detail(good) == []
+    assert bench.validate_detail({"privacy": {"error": "boom"}}) == []
+    empty = dict(good["privacy"], dp_utility={})
+    assert any(
+        "privacy['dp_utility'] is empty" in v
+        for v in bench.validate_detail({"privacy": empty})
+    )
+    mistyped = dict(
+        good["privacy"],
+        dp_utility=dict(good["privacy"]["dp_utility"],
+                        **{"sigma_1.1": dict(arm, epsilon="high")}),
+    )
+    assert any(
+        "privacy.dp_utility['sigma_1.1']" in v
+        for v in bench.validate_detail({"privacy": mistyped})
+    )
+    nodrill = {k: v for k, v in good["privacy"].items() if k != "secagg_drill"}
+    assert any(
+        "privacy['secagg_drill'] missing" in v
+        for v in bench.validate_detail({"privacy": nodrill})
+    )
+    badbits = dict(
+        good["privacy"],
+        secagg_overhead=dict(good["privacy"]["secagg_overhead"], bits="24"),
+    )
+    assert any(
+        "privacy.secagg_overhead['bits']" in v
+        for v in bench.validate_detail({"privacy": badbits})
+    )
+    summary = bench.compact_summary({"detail": good})
+    assert "privacy" in summary["sections"]
+
+
+def test_committed_r23_artifact_privacy_contract():
+    """The round-23 acceptance pin: the committed CPU-smoke artifact ran
+    every section (skipped == []); the DP A/B carries the off arm plus at
+    least two noise levels with epsilon DECREASING as sigma rises (the
+    accountant's direction) and utility paid for it (drift > 0); the
+    secagg masking math is pinned EXACT against the plaintext weighted
+    sum; and the real-gRPC dropped-masker drill recovered the pad and
+    closed to the survivors' mean bit-for-bit with zero torn rounds."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "bench_runs", "r23_privacy_cpu_smoke.json")
+    with open(path) as f:
+        art = json.load(f)
+    assert art["detail"]["skipped"] == []
+    priv = art["detail"]["privacy"]
+    assert "error" not in priv
+    arms = priv["dp_utility"]
+    assert "off" in arms and len(arms) >= 3
+    assert arms["off"]["epsilon"] is None
+    assert arms["off"]["weight_drift_vs_off"] == 0.0
+    noised = sorted(
+        (a for n, a in arms.items() if n != "off"),
+        key=lambda a: a["noise_multiplier"],
+    )
+    for lo, hi in zip(noised, noised[1:]):
+        # More noise buys a strictly smaller epsilon at equal rounds.
+        assert hi["epsilon"] < lo["epsilon"]
+    for a in noised:
+        assert a["epsilon"] > 0 and a["clip_norm"] > 0
+        assert a["weight_drift_vs_off"] > 0.0  # privacy is not free
+        assert 0.0 <= a["val_iou"] <= 1.0
+    over = priv["secagg_overhead"]
+    assert over["exact_vs_plaintext"] is True
+    assert over["masked_bytes"] > over["plaintext_bytes"]
+    assert 1.0 < over["wire_ratio"] < 3.0  # uint64 residues vs float32
+    drill = priv["secagg_drill"]
+    assert drill["fault_fired"] and drill["dropout_recovered"]
+    assert drill["exact_average_bit_for_bit"] is True
+    assert drill["torn_rounds"] == 0

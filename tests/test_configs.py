@@ -135,3 +135,25 @@ def test_c17_robust_aggregation_preset_round_trips():
     with open(os.path.join(ROOT, "configs", "c13_buffered_async.json")) as f:
         old = FedConfig.from_json(f.read())
     assert old.aggregation == "fedavg" and old.quarantine_z == 0.0
+
+
+def test_c19_privacy_preset_round_trips():
+    """The round-23 privacy preset: DP-SGD (clip + noise + budget) and
+    pairwise-mask secagg together. The preset must already satisfy
+    secagg's composition constraints (fedavg / no quarantine / null codec
+    / sync — validation would refuse it otherwise), and pre-r23 presets
+    load with both planes off."""
+    path = os.path.join(ROOT, "configs", "c19_privacy.json")
+    with open(path) as f:
+        cfg = FedConfig.from_json(f.read())
+    assert cfg.secagg is True and cfg.secagg_bits == 24
+    assert cfg.dp_clip_norm == 1.0 and cfg.dp_noise_multiplier == 1.1
+    assert cfg.dp_epsilon_budget == 8.0 and cfg.dp_seed == 42
+    # The constraints secagg's config validation enforces.
+    assert cfg.aggregation == "fedavg" and cfg.quarantine_z == 0.0
+    assert cfg.update_codec == "null" and cfg.mode == "sync"
+    assert FedConfig.from_json(cfg.to_json()) == cfg
+    # A pre-r23 preset (no privacy keys) keeps both planes off.
+    with open(os.path.join(ROOT, "configs", "c17_robust_aggregation.json")) as f:
+        old = FedConfig.from_json(f.read())
+    assert old.secagg is False and old.dp_noise_multiplier == 0.0

@@ -501,6 +501,75 @@ def test_health_report_joins_quarantine(tmp_path):
     )
 
 
+def test_health_report_joins_privacy_summary(tmp_path):
+    """Round 23: the privacy plane's summary (fed.rounds.privacy_summary)
+    joins the report behind --privacy — dp/secagg blocks typed (real
+    bools, not ints), per-client epsilon finite-nonnegative, and a
+    headline max_epsilon that disagrees with its own per-client rows trips
+    the guard (the one accounting-drift class this join exists to catch)."""
+    from fedcrack_tpu.fed import rounds as R
+    from fedcrack_tpu.fed.serialization import tree_to_bytes
+    from fedcrack_tpu.tools import health_report
+
+    cfg = FedConfig(
+        cohort_size=2, max_rounds=2, registration_window_s=1.0,
+        dp_clip_norm=1.0, dp_noise_multiplier=1.1, dp_sample_rate=0.01,
+        dp_steps_per_round=4, dp_delta=1e-5,
+    )
+    state = R.initial_state(cfg, {"w": np.zeros(6, np.float32)})
+    for n in ("a", "b"):
+        state, _ = R.transition(state, R.Ready(cname=n, now=0.0))
+    state = R._advance_time(state, 2.0)
+    blob = tree_to_bytes({"w": np.full(6, 0.5, np.float32)})
+    rnd = state.current_round
+    for n in ("a", "b"):
+        state, _ = R.transition(
+            state,
+            R.TrainDone(cname=n, blob=blob, num_samples=10, round=rnd, now=3.0),
+        )
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    hl.write_ledger_jsonl(state.ledger, ledger_path)
+    privacy_path = str(tmp_path / "privacy.json")
+    with open(privacy_path, "w", encoding="utf-8") as f:
+        json.dump(R.privacy_summary(state), f)
+    out_path = str(tmp_path / "report.json")
+    rc = health_report.main(
+        ["--ledger", ledger_path, "--privacy", privacy_path, "--out", out_path]
+    )
+    assert rc == 0
+    with open(out_path, encoding="utf-8") as f:
+        report = json.load(f)
+    assert health_report.validate_report(report) == []
+    dp = report["privacy"]["dp"]
+    assert dp["enabled"] is True and dp["noise_multiplier"] == 1.1
+    assert dp["clients"]["a"]["steps"] == 4
+    assert dp["max_epsilon"] == max(
+        c["epsilon"] for c in dp["clients"].values()
+    )
+    assert report["privacy"]["secagg"]["enabled"] is False
+    # A report WITHOUT the artifact records absence, not a plausible block.
+    assert health_report.build_report(ledger_path)["privacy"] is None
+    # Headline/per-client disagreement is the accounting bug the guard
+    # exists for.
+    broken = json.loads(json.dumps(report))
+    broken["privacy"]["dp"]["max_epsilon"] = 99.0
+    assert any(
+        "max_epsilon" in v for v in health_report.validate_report(broken)
+    )
+    # enabled must be a REAL bool — a 1 from a sloppy writer fails.
+    intbool = json.loads(json.dumps(report))
+    intbool["privacy"]["dp"]["enabled"] = 1
+    assert any(
+        "wants bool" in v for v in health_report.validate_report(intbool)
+    )
+    # Non-finite epsilon never ships.
+    inf = json.loads(json.dumps(report))
+    inf["privacy"]["dp"]["clients"]["a"]["epsilon"] = float("nan")
+    assert any(
+        "finite" in v for v in health_report.validate_report(inf)
+    )
+
+
 # ---------- the robust-aggregation A/B drill: response layer, end to end ----
 
 

@@ -1,0 +1,660 @@
+"""Privacy plane (round 23): DP-SGD + RDP accountant + pairwise-mask secagg.
+
+Four layers, each pinned where it can silently rot:
+
+- the RDP accountant's eps(delta) against regression pins and the q=1
+  closed form (min over orders of T*a/(2 sigma^2) + log(1/delta)/(a-1));
+- the DP-SGD host update's clip closed form and seeded-noise determinism
+  (same (seed, client, round) -> bit-identical noise);
+- the secagg residue ring: fixed-point round trips, pairwise masks
+  canceling EXACTLY (not approximately) across cohort sizes and upload
+  orders, and dropout recovery reconstructing the missing pads bit-for-bit;
+- the server state machine end to end in-process: roster freeze at the
+  RUNNING transition, the TrainingNotice roster reply, masked rounds
+  closing to the plaintext weighted fixed-point mean bit-for-bit (with and
+  without a dropped masker), epsilon charged into history + statefile and
+  surviving a serialize/restore cycle, and the budget finishing the
+  federation loudly.
+
+The real-gRPC secagg drill (dropped masker over the wire) lives in
+tests/test_chaos.py next to the other transport drills.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.privacy
+
+from fedcrack_tpu.configs import FedConfig
+from fedcrack_tpu.fed import rounds as R
+from fedcrack_tpu.fed.serialization import tree_from_bytes, tree_to_bytes
+from fedcrack_tpu.privacy import secagg as S
+from fedcrack_tpu.privacy.accountant import (
+    DEFAULT_ORDERS,
+    PrivacyAccountant,
+    compute_epsilon,
+    rdp_subsampled_gaussian,
+    rdp_to_epsilon,
+)
+from fedcrack_tpu.privacy.dpsgd import dp_update_host
+
+
+# ---- accountant ----
+
+
+def test_accountant_epsilon_regression_pin():
+    """The Abadi-regime pin: sigma=1.1, q=0.01, T=1000, delta=1e-5. The
+    value is this implementation's output, pinned so a refactor cannot
+    silently change what the server REPORTS as spent privacy."""
+    eps = compute_epsilon(0.01, 1.1, 1000, 1e-5)
+    assert eps == pytest.approx(2.0867961135743176, rel=1e-9)
+
+
+def test_accountant_full_batch_closed_form():
+    """At q=1 subsampling amplifies nothing: per-step RDP of the Gaussian
+    mechanism is exactly a/(2 sigma^2), so eps(delta) is the direct
+    minimization over orders — computable in four lines here and required
+    to match the production path bit-for-bit."""
+    sigma, steps, delta = 1.1, 1000, 1e-5
+    expected = min(
+        steps * a / (2.0 * sigma * sigma) + math.log(1.0 / delta) / (a - 1.0)
+        for a in DEFAULT_ORDERS
+        if a > 1
+    )
+    assert compute_epsilon(1.0, sigma, steps, delta) == pytest.approx(
+        expected, rel=1e-12
+    )
+    assert compute_epsilon(1.0, sigma, steps, delta) == pytest.approx(
+        837.9592064567056, rel=1e-9
+    )
+
+
+def test_accountant_monotone_and_zero():
+    eps = [compute_epsilon(0.01, 1.1, t, 1e-5) for t in (0, 1, 10, 100, 1000)]
+    assert eps[0] == 0.0
+    assert all(a < b for a, b in zip(eps, eps[1:]))
+    # More noise -> less epsilon at equal steps.
+    assert compute_epsilon(0.01, 2.0, 100, 1e-5) < compute_epsilon(
+        0.01, 1.1, 100, 1e-5
+    )
+
+
+def test_accountant_class_tracks_per_client_and_round_trips_wire():
+    acct = PrivacyAccountant(
+        noise_multiplier=1.1, sample_rate=0.01, delta=1e-5
+    )
+    acct.record(["a", "b"], steps=3)
+    acct.record(["a"], steps=2)
+    assert acct.epsilon_of("a") > acct.epsilon_of("b") > 0.0
+    assert acct.epsilon_of("a") == pytest.approx(
+        compute_epsilon(0.01, 1.1, 5, 1e-5), rel=1e-9
+    )
+    assert acct.max_epsilon() == acct.epsilon_of("a")
+    twin = PrivacyAccountant(
+        noise_multiplier=1.1, sample_rate=0.01, delta=1e-5
+    )
+    twin.load_wire(acct.to_wire())
+    assert twin.epsilons() == acct.epsilons()
+
+
+# ---- DP-SGD host update ----
+
+
+def _vec_tree(value, n=8):
+    return {"params": {"w": np.full(n, value, np.float32)}}
+
+
+def test_dp_clip_closed_form():
+    """Delta norm 10 clipped to 1.0: the private update is base +
+    delta/10, exactly (noise off)."""
+    base = _vec_tree(0.0, 4)
+    trained = {"params": {"w": np.float32([10.0, 0.0, 0.0, 0.0])}}
+    out = dp_update_host(
+        trained, base, clip_norm=1.0, noise_multiplier=0.0,
+        dp_seed=7, cname="a", round_idx=1,
+    )
+    np.testing.assert_array_equal(
+        out["params"]["w"], np.float32([1.0, 0.0, 0.0, 0.0])
+    )
+    # Inside the ball the update passes through untouched.
+    small = {"params": {"w": np.float32([0.3, 0.0, 0.0, 0.0])}}
+    out2 = dp_update_host(
+        small, base, clip_norm=1.0, noise_multiplier=0.0,
+        dp_seed=7, cname="a", round_idx=1,
+    )
+    np.testing.assert_array_equal(out2["params"]["w"], small["params"]["w"])
+
+
+def test_dp_noise_is_seeded_per_client_and_round():
+    base, trained = _vec_tree(0.0), _vec_tree(0.5)
+    kw = dict(clip_norm=1.0, noise_multiplier=1.1, dp_seed=42)
+    a1 = dp_update_host(trained, base, cname="a", round_idx=3, **kw)
+    a2 = dp_update_host(trained, base, cname="a", round_idx=3, **kw)
+    b = dp_update_host(trained, base, cname="b", round_idx=3, **kw)
+    a_next = dp_update_host(trained, base, cname="a", round_idx=4, **kw)
+    np.testing.assert_array_equal(a1["params"]["w"], a2["params"]["w"])
+    assert not np.array_equal(a1["params"]["w"], b["params"]["w"])
+    assert not np.array_equal(a1["params"]["w"], a_next["params"]["w"])
+    assert np.all(np.isfinite(a1["params"]["w"]))
+
+
+# ---- secagg residue ring ----
+
+
+def test_fixed_point_round_trip_exact_at_bits_precision():
+    rng = np.random.Generator(np.random.Philox(key=3))
+    tree = {"w": rng.standard_normal(64).astype(np.float32)}
+    bits = 24
+    enc = S.fixed_point_encode(tree, bits)
+    dec = S.fixed_point_decode(enc, 1, bits, tree)
+    # Quantization error is bounded by half an LSB of the fixed point.
+    assert np.max(np.abs(dec["w"] - tree["w"])) <= 0.5 / (1 << bits)
+    # And a round-tripped quantized tree is a fixed point of the codec.
+    enc2 = S.fixed_point_encode(dec, bits)
+    for a, b in zip(enc, enc2):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("n_clients", [2, 3, 5])
+def test_mask_cancellation_exact(n_clients):
+    """The tentpole identity: summing every client's MASKED residues in
+    any order equals the plaintext weighted fixed-point sum bit-for-bit —
+    uint64 wraparound addition is associative-exact, and the pairwise
+    pads telescope to zero."""
+    rng = np.random.Generator(np.random.Philox(key=11))
+    names = [f"c{i}" for i in range(n_clients)]
+    cohort = {n: S.client_seed(n) for n in names}
+    roster = S.round_roster(cohort, 2)
+    trees = [
+        {"w": rng.standard_normal(33).astype(np.float32)} for _ in names
+    ]
+    samples = [7 * (i + 1) for i in range(n_clients)]
+    expected = S.weighted_fixed_sum(trees, samples, 24)
+    for perm in ([*range(n_clients)], [*reversed(range(n_clients))]):
+        total = None
+        for i in perm:
+            blob = S.mask_update(
+                trees[i], cname=names[i], n_samples=samples[i],
+                roster=roster, bits=24,
+            )
+            leaves = [
+                np.asarray(x, np.uint64)
+                for x in S.decode_masked(blob)["leaves"]
+            ]
+            total = (
+                leaves
+                if total is None
+                else [a + b for a, b in zip(total, leaves)]
+            )
+        for a, b in zip(total, expected):
+            np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("dropped", [("c0",), ("c2",), ("c0", "c3")])
+def test_dropout_recovery_exact(dropped):
+    """Survivors' masked sum still carries pads toward the dropped; the
+    server reconstructs each (survivor, dropped) pad from roster seeds and
+    subtracts — the unmasked sum equals the SURVIVORS' plaintext weighted
+    sum bit-for-bit (ragged dropout sweep)."""
+    rng = np.random.Generator(np.random.Philox(key=13))
+    names = [f"c{i}" for i in range(4)]
+    cohort = {n: S.client_seed(n) for n in names}
+    roster = S.round_roster(cohort, 5)
+    trees = {n: {"w": rng.standard_normal(17).astype(np.float32)} for n in names}
+    samples = {n: 10 + i for i, n in enumerate(names)}
+    survivors = [n for n in names if n not in dropped]
+    uploads = {
+        n: S.decode_masked(
+            S.mask_update(
+                trees[n], cname=n, n_samples=samples[n],
+                roster=roster, bits=24,
+            )
+        )
+        for n in survivors
+    }
+    total, total_samples, recovered = S.unmask_sum(uploads, roster, 24)
+    assert recovered == sorted(dropped)
+    assert total_samples == sum(samples[n] for n in survivors)
+    expected = S.weighted_fixed_sum(
+        [trees[n] for n in survivors], [samples[n] for n in survivors], 24
+    )
+    for a, b in zip(total, expected):
+        np.testing.assert_array_equal(a, b)
+    mean = S.unmasked_mean(total, total_samples, trees[names[0]], 24)
+    ref = S.fixed_point_decode(
+        expected, total_samples, 24, trees[names[0]]
+    )
+    np.testing.assert_array_equal(mean["w"], ref["w"])
+
+
+def test_round_roster_never_repeats_pads():
+    cohort = {"a": S.client_seed("a"), "b": S.client_seed("b")}
+    r1, r2 = S.round_roster(cohort, 1), S.round_roster(cohort, 2)
+    assert set(r1) == set(r2) == {"a", "b"}
+    assert r1 != r2  # a fresh pad basis every round
+    assert S.round_roster(cohort, 1) == r1  # but deterministic per round
+    m1 = S.pair_mask(S.pair_seed("a", r1["a"], "b", r1["b"]), [(5,)])
+    m2 = S.pair_mask(S.pair_seed("a", r2["a"], "b", r2["b"]), [(5,)])
+    assert not np.array_equal(m1[0], m2[0])
+
+
+def test_client_seed_fits_signed_int64():
+    """The enroll seed travels in the proto Scalar's SIGNED as_int: 63
+    bits, deterministic, distinct per client."""
+    for name in ("a", "b", "worker-17", "edge/0"):
+        seed = S.client_seed(name)
+        assert 0 <= seed < 2**63
+        assert seed == S.client_seed(name)
+    assert S.client_seed("a") != S.client_seed("b")
+
+
+def test_validate_masked_gate():
+    tree = {"w": np.zeros(4, np.float32)}
+    cohort = {"a": 1, "b": 2}
+    roster = S.round_roster(cohort, 1)
+    blob = S.mask_update(tree, cname="a", n_samples=3, roster=roster, bits=24)
+    assert S.validate_masked(blob, tree, bits=24, cohort=roster) is None
+    # Wrong precision, stale cohort, and plaintext all REJECT loudly.
+    assert S.validate_masked(blob, tree, bits=16, cohort=roster) is not None
+    assert (
+        S.validate_masked(blob, tree, bits=24, cohort={"a": 1, "c": 9})
+        is not None
+    )
+    assert S.validate_masked(tree_to_bytes(tree), tree, bits=24, cohort=roster)
+    assert not S.is_masked_blob(tree_to_bytes(tree))
+    assert S.is_masked_blob(blob)
+
+
+# ---- config validation ----
+
+
+def test_config_validation_refuses_bad_privacy_combos():
+    ok = dict(
+        secagg=True, aggregation="fedavg", quarantine_z=0.0,
+        update_codec="null", mode="sync",
+    )
+    FedConfig(**ok)  # the valid combination loads
+    with pytest.raises(ValueError, match="privacy/robustness"):
+        FedConfig(**{**ok, "aggregation": "trimmed_mean"})
+    with pytest.raises(ValueError, match="quarantine_z=0"):
+        FedConfig(**{**ok, "quarantine_z": 3.5})
+    with pytest.raises(ValueError, match="update_codec='null'"):
+        FedConfig(**{**ok, "update_codec": "int8"})
+    with pytest.raises(ValueError, match="mode='sync'"):
+        FedConfig(**{**ok, "mode": "buffered"})
+    with pytest.raises(ValueError, match="secagg_bits"):
+        FedConfig(secagg_bits=60)
+    with pytest.raises(ValueError, match="dp_clip_norm > 0"):
+        FedConfig(dp_noise_multiplier=1.0)
+    with pytest.raises(ValueError, match="dp_sample_rate"):
+        FedConfig(dp_sample_rate=0.0)
+    with pytest.raises(ValueError, match="dp_delta"):
+        FedConfig(dp_delta=1.0)
+
+
+# ---- the server state machine, in-process ----
+
+_TMPL = {"w": np.zeros(6, np.float32)}
+
+
+def _enroll(cfg, names, with_seeds=True):
+    state = R.initial_state(cfg, _TMPL)
+    for n in names:
+        seed = S.client_seed(n) if with_seeds else None
+        state, rep = R.transition(state, R.Ready(cname=n, now=0.0, secagg_seed=seed))
+    return R._advance_time(state, cfg.registration_window_s + 1.0)
+
+
+def _secagg_cfg(**kw):
+    base = dict(
+        cohort_size=3, max_rounds=1, registration_window_s=1.0,
+        secagg=True, quarantine_z=0.0, update_codec="null",
+        aggregation="fedavg", mode="sync",
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def test_secagg_roster_freezes_at_running_and_notice_distributes_it():
+    cfg = _secagg_cfg()
+    state = _enroll(cfg, ["a", "b", "c"])
+    assert state.phase == R.PHASE_RUNNING
+    assert set(state.secagg_roster) == {"a", "b", "c"}
+    # Enroll-time seeds land verbatim; the notice reply hands the frozen
+    # roster + round index to every masker.
+    assert state.secagg_roster["a"] == S.client_seed("a")
+    state, rep = R.transition(state, R.TrainingNotice(cname="a", now=2.0))
+    roster_doc = json.loads(rep.config["__secagg_roster"])
+    assert {n: int(s) for n, s in roster_doc.items()} == dict(
+        state.secagg_roster
+    )
+    assert int(rep.config["current_round"]) == state.current_round
+    # A client that never shipped a seed still lands on the same roster
+    # entry via the deterministic fallback.
+    state2 = _enroll(cfg, ["a", "b", "c"], with_seeds=False)
+    assert dict(state2.secagg_roster) == dict(state.secagg_roster)
+
+
+def _masked_blob(state, name, tree, ns):
+    roster = S.round_roster(state.secagg_roster, state.current_round)
+    return S.mask_update(
+        tree, cname=name, n_samples=ns, roster=roster,
+        bits=state.config.secagg_bits,
+    )
+
+
+def test_secagg_round_closes_to_exact_fixed_point_mean():
+    cfg = _secagg_cfg()
+    state = _enroll(cfg, ["a", "b", "c"])
+    trees = {
+        "a": {"w": np.full(6, 1.0, np.float32)},
+        "b": {"w": np.full(6, 3.0, np.float32)},
+        "c": {"w": np.full(6, 5.0, np.float32)},
+    }
+    ns = {"a": 10, "b": 30, "c": 20}
+    rnd = state.current_round
+    for name in ("a", "b", "c"):
+        state, rep = R.transition(
+            state,
+            R.TrainDone(
+                cname=name, blob=_masked_blob(state, name, trees[name], ns[name]),
+                num_samples=ns[name], round=rnd, now=2.0,
+            ),
+        )
+    assert state.phase == R.PHASE_FINISHED
+    entry = state.history[-1]
+    assert entry["secagg"]["maskers"] == ["a", "b", "c"]
+    assert entry["secagg"]["recovered"] == []
+    got = tree_from_bytes(state.global_blob, template=_TMPL)
+    expected = S.fixed_point_decode(
+        S.weighted_fixed_sum(
+            [trees[n] for n in ("a", "b", "c")], [10, 30, 20],
+            cfg.secagg_bits,
+        ),
+        60, cfg.secagg_bits, _TMPL,
+    )
+    np.testing.assert_array_equal(got["w"], expected["w"])
+
+
+def test_secagg_dropout_round_recovers_and_matches_survivor_mean():
+    cfg = _secagg_cfg(quorum_fraction=0.67, round_deadline_s=5.0)
+    state = _enroll(cfg, ["a", "b", "c"])
+    trees = {
+        "a": {"w": np.full(6, 1.0, np.float32)},
+        "b": {"w": np.full(6, 3.0, np.float32)},
+    }
+    rnd = state.current_round
+    for name in ("a", "b"):
+        state, rep = R.transition(
+            state,
+            R.TrainDone(
+                cname=name, blob=_masked_blob(state, name, trees[name], 10),
+                num_samples=10, round=rnd, now=2.0,
+            ),
+        )
+    assert state.phase == R.PHASE_RUNNING  # quorum met, deadline not yet
+    state = R._advance_time(state, 100.0)
+    assert state.phase == R.PHASE_FINISHED
+    entry = state.history[-1]
+    assert entry["secagg"]["maskers"] == ["a", "b"]
+    assert entry["secagg"]["recovered"] == ["c"]
+    got = tree_from_bytes(state.global_blob, template=_TMPL)
+    expected = S.fixed_point_decode(
+        S.weighted_fixed_sum(
+            [trees["a"], trees["b"]], [10, 10], cfg.secagg_bits
+        ),
+        20, cfg.secagg_bits, _TMPL,
+    )
+    np.testing.assert_array_equal(got["w"], expected["w"])
+
+
+def test_secagg_rejects_plaintext_and_wrong_roster_uploads():
+    cfg = _secagg_cfg()
+    state = _enroll(cfg, ["a", "b", "c"])
+    rnd = state.current_round
+    tree = {"w": np.ones(6, np.float32)}
+    state, rep = R.transition(
+        state,
+        R.TrainDone(
+            cname="a", blob=tree_to_bytes(tree), num_samples=10,
+            round=rnd, now=2.0,
+        ),
+    )
+    assert rep.status == R.REJECTED
+    # Wrong fixed-point precision fails the structural gate.
+    narrow = S.mask_update(
+        tree, cname="b", n_samples=10,
+        roster=S.round_roster(state.secagg_roster, rnd), bits=16,
+    )
+    state, rep = R.transition(
+        state,
+        R.TrainDone(cname="b", blob=narrow, num_samples=10, round=rnd, now=2.0),
+    )
+    assert rep.status == R.REJECTED
+    # The sample count inside the masked frame must agree with the event.
+    lying = S.mask_update(
+        tree, cname="c", n_samples=10,
+        roster=S.round_roster(state.secagg_roster, rnd),
+        bits=cfg.secagg_bits,
+    )
+    state, rep = R.transition(
+        state,
+        R.TrainDone(cname="c", blob=lying, num_samples=25, round=rnd, now=2.0),
+    )
+    assert rep.status == R.REJECTED
+
+
+def _dp_cfg(**kw):
+    base = dict(
+        cohort_size=2, max_rounds=3, registration_window_s=1.0,
+        dp_clip_norm=1.0, dp_noise_multiplier=1.1, dp_sample_rate=0.01,
+        dp_steps_per_round=4, dp_delta=1e-5,
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def _run_dp_round(state, now):
+    rnd = state.current_round
+    blob = tree_to_bytes({"w": np.full(6, 0.5, np.float32)})
+    for n in sorted(state.cohort):
+        state, _ = R.transition(
+            state,
+            R.TrainDone(cname=n, blob=blob, num_samples=10, round=rnd, now=now),
+        )
+    return state
+
+
+def test_dp_epsilon_charged_into_history_and_summary():
+    cfg = _dp_cfg()
+    state = _enroll(cfg, ["a", "b"])
+    state = _run_dp_round(state, 2.0)
+    entry = state.history[-1]
+    assert dict(state.privacy_steps) == {"a": 4, "b": 4}
+    assert entry["epsilon"]["a"] == pytest.approx(
+        compute_epsilon(0.01, 1.1, 4, 1e-5), abs=1e-6
+    )
+    assert "epsilon_budget_exhausted" not in entry
+    summary = R.privacy_summary(state)
+    assert summary["dp"]["enabled"] is True
+    assert summary["dp"]["clients"]["a"]["steps"] == 4
+    assert summary["dp"]["max_epsilon"] == pytest.approx(
+        entry["epsilon"]["a"], abs=1e-9
+    )
+    assert summary["secagg"]["enabled"] is False
+
+
+def test_dp_budget_exhaustion_finishes_loudly():
+    # Budget sits strictly between the 1-round and 2-round spends (eps
+    # grows sublinearly at small step counts — a multiplier would miss).
+    eps_r1 = compute_epsilon(0.01, 1.1, 4, 1e-5)
+    eps_r2 = compute_epsilon(0.01, 1.1, 8, 1e-5)
+    cfg = _dp_cfg(dp_epsilon_budget=(eps_r1 + eps_r2) / 2.0)
+    state = _enroll(cfg, ["a", "b"])
+    state = _run_dp_round(state, 2.0)
+    assert state.phase == R.PHASE_RUNNING  # one round spent, budget not hit
+    state = _run_dp_round(state, 3.0)
+    assert state.phase == R.PHASE_FINISHED  # budget breached before max_rounds
+    assert state.history[-1]["epsilon_budget_exhausted"] is True
+    assert state.current_round <= cfg.max_rounds
+
+
+def test_privacy_maps_survive_statefile_round_trip():
+    """Mid-round kill-restart: seeds, roster and the accountant's step
+    counts are statefile-persisted; epsilon is RECOMPUTED from the
+    restored steps, never stored — so a restart cannot fork the spend."""
+    from fedcrack_tpu.ckpt.statefile import (
+        server_state_from_bytes,
+        server_state_to_bytes,
+    )
+
+    cfg = _dp_cfg()
+    state = _enroll(cfg, ["a", "b"])
+    state = _run_dp_round(state, 2.0)
+    state = state._replace(
+        secagg_seeds={"a": 123, "b": 456},
+        secagg_roster={"a": 123, "b": 456},
+    )
+    blob = server_state_to_bytes(state)
+    restored = server_state_from_bytes(blob, cfg)
+    assert dict(restored.privacy_steps) == {"a": 4, "b": 4}
+    assert dict(restored.secagg_seeds) == {"a": 123, "b": 456}
+    assert dict(restored.secagg_roster) == {"a": 123, "b": 456}
+    assert R._epsilons_for(cfg, restored.privacy_steps) == R._epsilons_for(
+        cfg, state.privacy_steps
+    )
+    # Byte-stable: re-serializing the restored state is identical.
+    assert server_state_to_bytes(restored) == blob
+
+
+def test_buffered_flush_charges_epsilon_and_respects_budget():
+    cfg = FedConfig(
+        mode="buffered", buffer_k=2, cohort_size=2, max_rounds=5,
+        registration_window_s=1.0, dp_clip_norm=1.0,
+        dp_noise_multiplier=1.1, dp_sample_rate=0.01,
+        dp_steps_per_round=3, dp_delta=1e-5,
+    )
+
+    def run(cfg):
+        state = _enroll(cfg, ["a", "b"], with_seeds=False)
+        for n in ("a", "b"):
+            state, _ = R.transition(state, R.PullWeights(cname=n, now=1.5))
+        blob = tree_to_bytes({"w": np.full(6, 0.5, np.float32)})
+        rnd = state.current_round
+        for n in ("a", "b"):
+            state, _ = R.transition(
+                state,
+                R.TrainDone(cname=n, blob=blob, num_samples=10, round=rnd, now=2.0),
+            )
+        return state
+
+    state = run(cfg)
+    entry = state.history[-1]
+    assert dict(state.privacy_steps) == {"a": 3, "b": 3}
+    assert entry["epsilon"]["a"] == pytest.approx(
+        compute_epsilon(0.01, 1.1, 3, 1e-5), abs=1e-6
+    )
+    tight = dataclasses.replace(
+        cfg, dp_epsilon_budget=entry["epsilon"]["a"] * 0.5
+    )
+    state2 = run(tight)
+    assert state2.phase == R.PHASE_FINISHED
+    assert state2.history[-1]["epsilon_budget_exhausted"] is True
+
+
+# ---- the mesh twin's null-build pin ----
+
+
+def test_mesh_dp_off_build_is_the_null_twin():
+    """dp_clip_norm=0 must be byte-identical to a build that never heard
+    of DP — the r12 codec-twin discipline: the off program IS the old
+    program, pinned by running both over the same data."""
+    import jax
+
+    from fedcrack_tpu.configs import ModelConfig
+    from fedcrack_tpu.data.synthetic import synth_crack_batch
+    from fedcrack_tpu.parallel import make_mesh, run_mesh_federation
+    from fedcrack_tpu.parallel.fedavg_mesh import (
+        build_federated_round,
+        stack_client_data,
+    )
+    from fedcrack_tpu.train.local import create_train_state
+
+    tiny = ModelConfig(
+        img_size=16, stem_features=4, encoder_features=(8,),
+        decoder_features=(8, 4),
+    )
+    steps, batch = 1, 2
+    mesh = make_mesh(1, 1)
+    init = create_train_state(jax.random.key(0), tiny).variables
+
+    def data_fn(r):
+        images, masks = stack_client_data(
+            [synth_crack_batch(steps * batch, img_size=16, seed=r)],
+            steps, batch,
+        )
+        return (
+            images, masks, np.ones(1, np.float32),
+            np.full(1, float(steps * batch), np.float32),
+        )
+
+    legacy = build_federated_round(mesh, tiny, learning_rate=1e-3, local_epochs=1)
+    dp_off = build_federated_round(
+        mesh, tiny, learning_rate=1e-3, local_epochs=1,
+        dp_clip_norm=0.0, dp_noise_multiplier=0.0, dp_seed=99,
+    )
+    assert legacy.dp == "null" and dp_off.dp == "null"
+    v_legacy, _ = run_mesh_federation(legacy, init, data_fn, 1, mesh)
+    v_off, _ = run_mesh_federation(dp_off, init, data_fn, 1, mesh)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(v_legacy), jax.tree_util.tree_leaves(v_off)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # The on build is its own program, tagged so the driver knows.
+    dp_on = build_federated_round(
+        mesh, tiny, learning_rate=1e-3, local_epochs=1,
+        dp_clip_norm=1.0, dp_noise_multiplier=1.1, dp_seed=42,
+    )
+    assert dp_on.dp == "dpsgd"
+
+
+# ---- the real-gRPC drills (tools/chaos_drill) ----
+
+
+def test_secagg_dropout_drill_over_real_grpc():
+    """The acceptance drill: three maskers over a real gRPC server, one
+    killed by a chaos-plan SECAGG_DROPOUT after its masks are committed to
+    the roster; the round still closes, the dropped pad is recovered from
+    the enroll seeds, and the unmasked global equals the SURVIVORS'
+    plaintext fixed-point mean bit-for-bit with zero torn rounds."""
+    from fedcrack_tpu.tools.chaos_drill import run_secagg_dropout_drill
+
+    out = run_secagg_dropout_drill()
+    assert out["fault_fired"] is True
+    assert out["dropper_crashed"] is True
+    assert out["survivors_completed"] is True
+    assert out["round_closed"] is True
+    assert out["maskers"] == ["a", "b"]
+    assert out["recovered"] == ["c"]
+    assert out["dropout_recovered"] is True
+    assert out["exact_average_bit_for_bit"] is True
+    assert out["torn_rounds"] == 0
+
+
+@pytest.mark.slow
+def test_dp_replay_drill_bit_identical():
+    """Chaos-retried DP rounds never double-draw noise: the injected
+    device failure forces a retry whose trajectory is bit-identical to an
+    uninterrupted run (the noise key chain restores with codec_state)."""
+    from fedcrack_tpu.tools.chaos_drill import run_dp_replay_drill
+
+    out = run_dp_replay_drill()
+    assert out["fault_fired"] is True
+    assert out["retries_round_0"] >= 1
+    assert out["replay_bit_identical"] is True
